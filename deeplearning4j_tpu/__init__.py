@@ -1,0 +1,42 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of Eclipse
+Deeplearning4j (reference: /root/reference, surveyed in SURVEY.md). Currently
+implemented: builder-configured networks (sequential ``MultiLayerNetwork``
+and DAG ``ComputationGraph``), the core layer set (dense/conv/pool/norm/
+RNN/VAE/YOLO), updaters + LR schedules, evaluation metrics, zip
+checkpointing, the data pipeline (datasets/iterators/normalizers), and
+numeric gradient checking. See SURVEY.md §2/§7 for the full parity roadmap
+(parallelism, zoo, Keras import, NLP, observability) built out incrementally.
+
+Design principles (TPU-first, NOT a port):
+- Parameters are immutable pytrees; training steps are pure jit'd functions
+  (replaces the reference's flat-params-vector view mutation,
+  nn/api/Model.java:105-145).
+- Backward passes come from ``jax.grad`` (replaces hand-written
+  ``backpropGradient`` per layer, nn/api/Layer.java:38).
+- Recurrence and truncated BPTT use ``jax.lax.scan`` (replaces the Java
+  per-timestep loops, nn/layers/recurrent/LSTMHelpers.java).
+- Data parallelism is a sharded train step with ``jax.lax.psum`` over a
+  device mesh (replaces ParallelWrapper param averaging and the Aeron
+  parameter server, SURVEY.md §5).
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf.configuration import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+
+__all__ = [
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "ComputationGraphConfiguration",
+    "MultiLayerNetwork",
+    "ComputationGraph",
+    "__version__",
+]

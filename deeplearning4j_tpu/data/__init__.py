@@ -1,0 +1,14 @@
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
+    AsyncDataSetIterator, MultipleEpochsIterator,
+)
+from deeplearning4j_tpu.data.normalizers import (
+    NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "ExistingDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
+    "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
+]

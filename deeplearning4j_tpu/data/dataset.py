@@ -1,0 +1,80 @@
+"""DataSet / MultiDataSet containers.
+
+Parity surface: nd4j ``DataSet`` (features+labels+masks) and ``MultiDataSet``
+consumed throughout the reference (MultiLayerNetwork.fit, ComputationGraph.fit).
+Arrays are host numpy until they hit the jit boundary — device transfer happens
+once per batch in the train step, and on TPU the transfer overlaps compute via
+jax's async dispatch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, List
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    features: np.ndarray = None
+    labels: np.ndarray = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self):
+        return 0 if self.features is None else int(self.features.shape[0])
+
+    def to_multi(self) -> "MultiDataSet":
+        return MultiDataSet(
+            features=[self.features], labels=[self.labels],
+            features_masks=[self.features_mask], labels_masks=[self.labels_mask])
+
+    def split_test_and_train(self, n_train: int):
+        a = DataSet(self.features[:n_train], self.labels[:n_train],
+                    None if self.features_mask is None else self.features_mask[:n_train],
+                    None if self.labels_mask is None else self.labels_mask[:n_train])
+        b = DataSet(self.features[n_train:], self.labels[n_train:],
+                    None if self.features_mask is None else self.features_mask[n_train:],
+                    None if self.labels_mask is None else self.labels_mask[n_train:])
+        return a, b
+
+    def shuffle(self, seed=None):
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+        return self
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        n = self.num_examples()
+        return [DataSet(
+            self.features[i:i + batch_size], self.labels[i:i + batch_size],
+            None if self.features_mask is None else self.features_mask[i:i + batch_size],
+            None if self.labels_mask is None else self.labels_mask[i:i + batch_size])
+            for i in range(0, n, batch_size)]
+
+    @staticmethod
+    def merge(datasets: List["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]),
+            None if datasets[0].features_mask is None else
+            np.concatenate([d.features_mask for d in datasets]),
+            None if datasets[0].labels_mask is None else
+            np.concatenate([d.labels_mask for d in datasets]))
+
+
+@dataclass
+class MultiDataSet:
+    features: List[np.ndarray] = field(default_factory=list)
+    labels: List[np.ndarray] = field(default_factory=list)
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self):
+        return 0 if not self.features else int(self.features[0].shape[0])

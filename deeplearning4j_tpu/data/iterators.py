@@ -1,0 +1,216 @@
+"""DataSet iterators.
+
+Parity surface: reference DataSetIterator contract + wrappers —
+AsyncDataSetIterator (deeplearning4j-nn/.../datasets/iterator/, background
+prefetch used at MultiLayerNetwork.java:1161), MultipleEpochsIterator,
+ExistingDataSetIterator, ListDataSetIterator (simple in-memory batching).
+
+TPU note: host→device transfer is already asynchronous under jax; the async
+iterator here overlaps host-side ETL (decode/augment/normalize) with device
+compute using a background thread + bounded queue, which is the role the
+reference's AsyncDataSetIterator plays.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base contract: iterable of DataSet with reset()."""
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch(self) -> int:
+        return -1
+
+    def total_outcomes(self) -> int:
+        return -1
+
+    def input_columns(self) -> int:
+        return -1
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Batches an in-memory DataSet (parity: ListDataSetIterator)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, shuffle=False, seed=123,
+                 drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._pos = 0
+        self._order = np.arange(dataset.num_examples())
+
+    def reset(self):
+        self._pos = 0
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            self._order = rng.permutation(self.dataset.num_examples())
+        self._epoch += 1
+
+    def __next__(self):
+        n = self.dataset.num_examples()
+        if self._pos >= n:
+            raise StopIteration
+        end = min(self._pos + self.batch_size, n)
+        if self.drop_last and end - self._pos < self.batch_size:
+            raise StopIteration
+        idx = self._order[self._pos:end]
+        self._pos = end
+        d = self.dataset
+        return DataSet(
+            d.features[idx], d.labels[idx],
+            None if d.features_mask is None else d.features_mask[idx],
+            None if d.labels_mask is None else d.labels_mask[idx])
+
+    def batch(self):
+        return self.batch_size
+
+    def total_outcomes(self):
+        return int(self.dataset.labels.shape[-1])
+
+    def input_columns(self):
+        return int(np.prod(self.dataset.features.shape[1:]))
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wraps a list/iterable of DataSets (parity: ExistingDataSetIterator)."""
+
+    def __init__(self, datasets: List[DataSet]):
+        self.datasets = list(datasets)
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def __next__(self):
+        if self._pos >= len(self.datasets):
+            raise StopIteration
+        d = self.datasets[self._pos]
+        self._pos += 1
+        return d
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper
+    (parity: AsyncDataSetIterator, queue size = prefetch buffer)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        self.base = base
+        self.queue_size = queue_size
+        self._q = None
+        self._thread = None
+        self._error = None
+        self._stop = None
+
+    def reset(self):
+        self._shutdown()
+        self.base.reset()
+        self._q = queue.Queue(maxsize=self.queue_size)
+        self._error = None
+        self._stop = stop = threading.Event()
+        q = self._q
+
+        def worker():
+            try:
+                while not stop.is_set():
+                    try:
+                        item = next(self.base)
+                    except StopIteration:
+                        break
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except Exception as e:  # propagate ETL errors to consumer
+                self._error = e
+            finally:
+                try:
+                    q.put_nowait(self._SENTINEL)
+                except queue.Full:
+                    pass
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if self._q is None:
+            self.reset()
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                # worker may have died with a full queue and dropped the
+                # sentinel; don't block forever
+                if self._thread is None or not self._thread.is_alive():
+                    if self._error is not None:
+                        raise self._error
+                    raise StopIteration
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def _shutdown(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=2.0)
+        self._thread = None
+        self._q = None
+        self._stop = None
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays a base iterator N times (parity: MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+        self._epoch = 0
+
+    def reset(self):
+        self._epoch = 0
+        self.base.reset()
+
+    def __next__(self):
+        try:
+            return next(self.base)
+        except StopIteration:
+            self._epoch += 1
+            if self._epoch >= self.epochs:
+                raise
+            self.base.reset()
+            return next(self.base)

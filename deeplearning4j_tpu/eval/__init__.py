@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.eval.evaluation import (
+    Evaluation, RegressionEvaluation, EvaluationBinary, ROC, ROCMultiClass,
+)
+
+__all__ = ["Evaluation", "RegressionEvaluation", "EvaluationBinary", "ROC",
+           "ROCMultiClass"]

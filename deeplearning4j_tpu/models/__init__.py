@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+
+__all__ = ["MultiLayerNetwork", "ComputationGraph"]

@@ -1,0 +1,389 @@
+"""MultiLayerNetwork — the sequential network container.
+
+Parity surface: reference nn/multilayer/MultiLayerNetwork.java (3,156 LoC):
+``init`` (:541), ``fit`` (:1156), ``output`` (:1947), ``score``,
+``computeGradientAndScore`` (:2206), truncated BPTT (:1219),
+``rnnTimeStep`` (:2209 stored-state path), plus the Solver/updater loop
+(optimize/Solver.java, BaseOptimizer.java:171).
+
+TPU design: ONE jit-compiled pure train step per network — forward, loss,
+``jax.grad`` backward, optax update, constraints — all fused by XLA into a
+single device program (the reference runs a Java-side loop over layers with a
+JNI call per op). Parameters/updater state are immutable pytrees; "mutation"
+is rebinding, and buffers are donated so XLA updates in place.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, List, Any, Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.updaters import make_gradient_transform
+from deeplearning4j_tpu.nn.layers.special import FrozenLayer
+
+
+def _dtype_of(name):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16, "float64": jnp.float64}[name]
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        conf.finalize()
+        self.conf = conf
+        self.layers = conf.layers
+        self.params: Optional[List[Dict]] = None
+        self.state: Optional[List[Dict]] = None
+        self.opt_state: Optional[List[Any]] = None
+        self.listeners: List[Any] = []
+        self.iteration = 0
+        self.epoch = 0
+        self._score = float("nan")
+        self._rnn_carries = None      # stored state for rnn_time_step
+        self._train_step = None
+        self._train_step_seq = None
+        self._output_fn = None
+        self._transforms = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng=None):
+        """Initialize parameters (parity: MultiLayerNetwork.init :541)."""
+        gc = self.conf.global_conf
+        dtype = _dtype_of(gc.dtype)
+        if rng is None:
+            rng = jax.random.PRNGKey(gc.seed)
+        keys = jax.random.split(rng, max(len(self.layers), 1))
+        self.params = [l.init(k, dtype) for l, k in zip(self.layers, keys)]
+        self.state = [l.init_state() for l in self.layers]
+        self._build_optimizer()
+        return self
+
+    def _build_optimizer(self):
+        gc = self.conf.global_conf
+        self._transforms = []
+        for l, p in zip(self.layers, self.params):
+            upd = l.updater or gc.updater
+            if isinstance(l, FrozenLayer) or not p:
+                self._transforms.append(optax.set_to_zero())
+            else:
+                self._transforms.append(make_gradient_transform(upd))
+        self.opt_state = [t.init(p) for t, p in zip(self._transforms, self.params)]
+        self._train_step = None  # force re-trace
+        self._output_fn = None
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    # ----------------------------------------------------------- forward core
+    def _forward(self, params, state, x, *, train, rng, mask=None, carries=None,
+                 upto=None):
+        """Pure forward through layers [0, upto). Returns (act, new_states,
+        new_carries)."""
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            x = x.astype(_dtype_of(gc.compute_dtype))
+        n = len(self.layers) if upto is None else upto
+        new_states = list(state)
+        new_carries = list(carries) if carries is not None else None
+        for i in range(n):
+            l = self.layers[i]
+            lrng = None if rng is None else jax.random.fold_in(rng, i)
+            if new_carries is not None and hasattr(l, "apply_with_carry"):
+                x, c = l.apply_with_carry(params[i], x, new_carries[i], mask=mask)
+                new_carries[i] = c
+            else:
+                x, st = l.apply(params[i], x, state[i], train=train, rng=lrng,
+                                mask=mask)
+                new_states[i] = st if st is not None else state[i]
+            if x.ndim == 2:
+                mask = None  # sequence collapsed to per-example
+        return x, new_states, new_carries
+
+    def _loss(self, params, state, x, y, rng, mask_f, mask_l, carries=None):
+        gc = self.conf.global_conf
+        out_layer = self.layers[-1]
+        act, new_states, new_carries = self._forward(
+            params, state, x, train=True, rng=rng, mask=mask_f, carries=carries,
+            upto=len(self.layers) - 1)
+        lrng = None if rng is None else jax.random.fold_in(rng, len(self.layers) - 1)
+        if hasattr(out_layer, "compute_score"):
+            loss = out_layer.compute_score(params[-1], act, y, mask_l,
+                                           train=True, rng=lrng)
+        else:
+            raise ValueError(
+                f"Last layer {type(out_layer).__name__} has no loss; use an "
+                "OutputLayer/LossLayer variant")
+        reg = 0.0
+        for l, p in zip(self.layers, params):
+            reg = reg + l.reg_loss(p)
+        loss = loss + reg
+        if gc.compute_dtype:
+            loss = loss.astype(jnp.float32)
+        return loss, (new_states, new_carries)
+
+    def _normalize_grads(self, grads):
+        gc = self.conf.global_conf
+        kind = gc.gradient_normalization
+        if not kind or kind == "None":
+            return grads
+        thr = gc.gradient_normalization_threshold
+        out = []
+        for g in grads:
+            if not g:
+                out.append(g)
+                continue
+            leaves = jax.tree_util.tree_leaves(g)
+            if kind == "ClipElementWiseAbsoluteValue":
+                g = jax.tree_util.tree_map(lambda a: jnp.clip(a, -thr, thr), g)
+            elif kind in ("ClipL2PerLayer", "RenormalizeL2PerLayer"):
+                norm = jnp.sqrt(sum((a ** 2).sum() for a in leaves))
+                if kind == "ClipL2PerLayer":
+                    scale = jnp.minimum(1.0, thr / jnp.maximum(norm, 1e-12))
+                else:
+                    scale = 1.0 / jnp.maximum(norm, 1e-12)
+                g = jax.tree_util.tree_map(lambda a: a * scale, g)
+            elif kind in ("ClipL2PerParamType", "RenormalizeL2PerParamType"):
+                def per_param(a):
+                    n = jnp.sqrt((a ** 2).sum())
+                    if kind == "ClipL2PerParamType":
+                        s = jnp.minimum(1.0, thr / jnp.maximum(n, 1e-12))
+                    else:
+                        s = 1.0 / jnp.maximum(n, 1e-12)
+                    return a * s
+                g = jax.tree_util.tree_map(per_param, g)
+            out.append(g)
+        return out
+
+    # ----------------------------------------------------------- train step
+    def _make_train_step(self, with_masks, with_carries):
+        transforms = self._transforms
+
+        def step(params, state, opt_state, x, y, it, mask_f, mask_l, carries):
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self.conf.global_conf.seed), it)
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(params, state, x, y, rng,
+                                          mask_f, mask_l, carries)
+            grads = self._normalize_grads(grads)
+            new_params, new_opt = [], []
+            for i, (l, t) in enumerate(zip(self.layers, transforms)):
+                if not params[i]:
+                    new_params.append(params[i])
+                    new_opt.append(opt_state[i])
+                    continue
+                u, o = t.update(grads[i], opt_state[i], params[i])
+                p = optax.apply_updates(params[i], u)
+                p = l.apply_constraints(p)
+                new_params.append(p)
+                new_opt.append(o)
+            return new_params, new_state, new_opt, loss, new_carries
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self, with_masks, with_carries):
+        key = (with_masks, with_carries)
+        if self._train_step is None:
+            self._train_step = {}
+        if key not in self._train_step:
+            self._train_step[key] = self._make_train_step(*key)
+        return self._train_step[key]
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs=1):
+        """fit(x, y) | fit(DataSet) | fit(iterator, epochs=N)
+        (parity: MultiLayerNetwork.fit :1156)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        if labels is not None:
+            return self._fit_batch(DataSet(data, labels))
+        if isinstance(data, DataSet):
+            return self._fit_batch(data)
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for batch in data:
+                self._fit_batch(batch if isinstance(batch, DataSet)
+                                else DataSet(*batch))
+            self.epoch += 1
+            for lst in self.listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self)
+        return self
+
+    def _fit_batch(self, ds):
+        gc = self.conf.global_conf
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        mf = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        ml = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        t0 = time.perf_counter()
+        if self.conf.backprop_type == "tbptt" and x.ndim == 3:
+            self._fit_tbptt(x, y, mf, ml)
+        else:
+            step = self._get_train_step(mf is not None or ml is not None, False)
+            self.params, self.state, self.opt_state, loss, _ = step(
+                self.params, self.state, self.opt_state, x, y,
+                jnp.asarray(self.iteration, jnp.int32), mf, ml, None)
+            self._score = float(loss)
+        self._last_fit_time = time.perf_counter() - t0
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+        return self
+
+    def _fit_tbptt(self, x, y, mf, ml):
+        """Truncated BPTT: slice time into tbptt_fwd_length chunks, carrying
+        RNN state (no gradient) across chunks (parity:
+        MultiLayerNetwork.doTruncatedBPTT :1219)."""
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = [None] * len(self.layers)
+        step = self._get_train_step(mf is not None or ml is not None, True)
+        losses = []
+        for start in range(0, T, L):
+            xs = x[:, start:start + L]
+            ys = y[:, start:start + L] if y.ndim == 3 else y
+            mfs = None if mf is None else mf[:, start:start + L]
+            mls = None if ml is None else ml[:, start:start + L]
+            self.params, self.state, self.opt_state, loss, carries = step(
+                self.params, self.state, self.opt_state, xs, ys,
+                jnp.asarray(self.iteration, jnp.int32), mfs, mls, carries)
+            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+            losses.append(float(loss))
+        self._score = float(np.mean(losses))
+
+    # ------------------------------------------------------------- inference
+    def output(self, x, train=False, mask=None):
+        """Forward pass to network output (parity: output :1947)."""
+        x = jnp.asarray(x)
+        if self._output_fn is None:
+            def fwd(params, state, x, mask):
+                act, _, _ = self._forward(params, state, x, train=False,
+                                          rng=None, mask=mask)
+                return act
+            self._output_fn = jax.jit(fwd)
+        return self._output_fn(self.params, self.state, x,
+                               None if mask is None else jnp.asarray(mask))
+
+    def feed_forward(self, x, train=False):
+        """All layer activations (parity: feedForward :852)."""
+        x = jnp.asarray(x)
+        acts = [x]
+        state = self.state
+        for i, l in enumerate(self.layers):
+            x, st = l.apply(self.params[i], x, state[i], train=train, rng=None)
+            acts.append(x)
+        return acts
+
+    def score(self, ds=None, x=None, y=None):
+        """Loss on a dataset (parity: MultiLayerNetwork.score)."""
+        if ds is not None:
+            x, y = ds.features, ds.labels
+            mf = ds.features_mask
+            ml = ds.labels_mask
+        else:
+            mf = ml = None
+        loss, _ = self._loss(self.params, self.state, jnp.asarray(x),
+                             jnp.asarray(y), None,
+                             None if mf is None else jnp.asarray(mf),
+                             None if ml is None else jnp.asarray(ml))
+        return float(loss)
+
+    def get_score(self):
+        return self._score
+
+    # ------------------------------------------------------------------ rnn
+    def rnn_time_step(self, x):
+        """Stateful single/multi-step inference (parity: rnnTimeStep :2362 in
+        ComputationGraph / MultiLayerNetwork.java:2209)."""
+        x = jnp.asarray(x)
+        if x.ndim == 2:
+            x = x[:, None, :]
+        if self._rnn_carries is None:
+            self._rnn_carries = [None] * len(self.layers)
+        act, _, self._rnn_carries = self._forward(
+            self.params, self.state, x, train=False, rng=None,
+            carries=self._rnn_carries)
+        return act
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    # ------------------------------------------------------------- evaluate
+    def evaluate(self, data, labels=None):
+        """Classification evaluation (parity: MultiLayerNetwork.evaluate)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.data.dataset import DataSet
+        ev = Evaluation()
+        if labels is not None:
+            data = [DataSet(data, labels)]
+        elif isinstance(data, DataSet):
+            data = [data]
+        elif hasattr(data, "reset"):
+            data.reset()
+        for ds in data:
+            if not isinstance(ds, DataSet):
+                ds = DataSet(*ds)
+            out = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), np.asarray(out),
+                    None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+        return ev
+
+    def evaluate_regression(self, data):
+        from deeplearning4j_tpu.eval.evaluation import RegressionEvaluation
+        from deeplearning4j_tpu.data.dataset import DataSet
+        ev = RegressionEvaluation()
+        if isinstance(data, DataSet):
+            data = [data]
+        elif hasattr(data, "reset"):
+            data.reset()
+        for ds in data:
+            out = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), np.asarray(out))
+        return ev
+
+    # ------------------------------------------------------------- utilities
+    def num_params(self):
+        return sum(int(np.prod(a.shape)) for a in
+                   jax.tree_util.tree_leaves(self.params))
+
+    def summary(self):
+        lines = ["=" * 70,
+                 f"{'Layer':<30}{'Type':<25}{'Params':>12}", "=" * 70]
+        for i, (l, p) in enumerate(zip(self.layers, self.params)):
+            n = sum(int(np.prod(a.shape)) for a in jax.tree_util.tree_leaves(p))
+            name = l.name or f"layer_{i}"
+            lines.append(f"{name:<30}{type(l).__name__:<25}{n:>12,}")
+        lines.append("=" * 70)
+        lines.append(f"Total params: {self.num_params():,}")
+        return "\n".join(lines)
+
+    def clone(self):
+        import copy as _copy
+        net = MultiLayerNetwork(MultiLayerConfiguration.from_json(self.conf.to_json()))
+        if self.params is not None:
+            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            net._build_optimizer()
+        return net
+
+    # persistence shortcuts (full impl in util/model_serializer.py)
+    def save(self, path, save_updater=True):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(self, path, save_updater)
+
+    @staticmethod
+    def load(path, load_updater=True):
+        from deeplearning4j_tpu.util.model_serializer import restore_multi_layer_network
+        return restore_multi_layer_network(path, load_updater)

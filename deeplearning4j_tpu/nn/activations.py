@@ -1,0 +1,81 @@
+"""Activation functions.
+
+Parity surface: the reference's ``IActivation`` implementations consumed by
+every layer (reference nd4j Activation enum; selected per-layer via
+NeuralNetConfiguration.Builder.activation, NeuralNetConfiguration.java:570).
+Here an activation is just a name → pure jnp function; gradients come from
+autodiff rather than hand-written ``backprop`` methods.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_E = 2.718281828459045
+
+
+def _softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _cube(x):
+    return x ** 3
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _rationaltanh(x):
+    # 1.7159 * tanh(2x/3) approximation used by the reference's RationalTanh
+    a = x * (2.0 / 3.0)
+    return 1.7159 * jnp.tanh(a)
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+ACTIVATIONS = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "prelu": lambda x: jax.nn.leaky_relu(x, 0.01),  # alpha handled by PReLU layer when learned
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "tanh": jnp.tanh,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": _hardsigmoid,
+    "hardtanh": _hardtanh,
+    "softmax": _softmax,
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": _cube,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def get_activation(name):
+    """Resolve an activation by name (case-insensitive) or pass a callable through."""
+    if callable(name):
+        return name
+    key = str(name).lower().replace("_", "")
+    if key not in ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name}'. Available: {sorted(ACTIVATIONS)}"
+        )
+    return ACTIVATIONS[key]

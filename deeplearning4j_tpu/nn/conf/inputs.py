@@ -1,0 +1,90 @@
+"""Input types and shape inference.
+
+Parity surface: reference ``InputType`` system
+(deeplearning4j-nn/.../nn/conf/inputs/InputType.java) — feed-forward,
+recurrent, convolutional, convolutional-flat — used by
+``MultiLayerConfiguration.setInputType`` to infer nIn per layer and insert
+preprocessors automatically.
+
+TPU note: internal convolutional layout is NHWC (channels-last), the layout
+the TPU vector units and XLA conv tiling prefer; the reference's NCHW
+(cuDNN-preferred) exists only at the import boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, asdict
+
+
+@dataclass(frozen=True)
+class InputType:
+    kind: str  # 'ff' | 'rnn' | 'cnn' | 'cnn_flat' | 'cnn3d'
+    size: int = 0          # ff: feature count
+    timeseries_length: int = -1  # rnn: -1 = variable
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    depth: int = 0         # cnn3d
+
+    # ---- factory methods (parity with InputType.feedForward etc.) ----
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=size)
+
+    @staticmethod
+    def recurrent(size: int, timeseries_length: int = -1) -> "InputType":
+        return InputType(kind="rnn", size=size, timeseries_length=timeseries_length)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn", height=height, width=width, channels=channels)
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn_flat", height=height, width=width, channels=channels)
+
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn3d", depth=depth, height=height, width=width,
+                         channels=channels)
+
+    # ---- helpers ----
+    def flat_size(self) -> int:
+        if self.kind == "ff":
+            return self.size
+        if self.kind == "rnn":
+            return self.size
+        if self.kind in ("cnn", "cnn_flat"):
+            return self.height * self.width * self.channels
+        if self.kind == "cnn3d":
+            return self.depth * self.height * self.width * self.channels
+        raise ValueError(self.kind)
+
+    def batch_shape(self, batch: int = 1):
+        """Concrete array shape for one minibatch (NHWC for cnn, (B,T,C) for rnn)."""
+        if self.kind == "ff" or self.kind == "cnn_flat":
+            return (batch, self.flat_size())
+        if self.kind == "rnn":
+            t = self.timeseries_length if self.timeseries_length > 0 else 8
+            return (batch, t, self.size)
+        if self.kind == "cnn":
+            return (batch, self.height, self.width, self.channels)
+        if self.kind == "cnn3d":
+            return (batch, self.depth, self.height, self.width, self.channels)
+        raise ValueError(self.kind)
+
+    def to_dict(self):
+        return asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return InputType(**d)
+
+
+def conv_output_size(size, kernel, stride, pad, dilation=1, mode="truncate"):
+    """Spatial output size of a conv/pool op. mode: 'same'|'truncate'|'strict'
+    (reference ConvolutionMode, nn/conf/ConvolutionMode.java)."""
+    if mode == "same":
+        return -(-size // stride)  # ceil
+    eff_k = kernel + (kernel - 1) * (dilation - 1)
+    return (size + 2 * pad - eff_k) // stride + 1

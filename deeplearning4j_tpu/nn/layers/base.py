@@ -1,0 +1,190 @@
+"""Layer base protocol + registry + JSON serde.
+
+Replaces the reference's two-sided design (declarative nn/conf/layers/*.java
+config POJOs + imperative nn/layers/** Layer impls with hand-written
+``backpropGradient``, nn/api/Layer.java:38): here a layer is ONE dataclass
+whose ``apply`` is a pure traced function; autodiff provides the backward.
+
+Protocol:
+- ``set_n_in(input_type)``  — infer input width (parity:
+  MultiLayerConfiguration.setInputType nIn inference).
+- ``output_type(input_type)`` — shape inference.
+- ``init(rng, dtype)`` — params pytree ({} if parameterless).
+- ``init_state()`` — non-trainable state pytree ({} if stateless; batchnorm
+  running stats live here, carried functionally through the train step).
+- ``apply(params, x, state=…, train=…, rng=…, mask=…)`` →
+  ``(y, new_state)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.updaters import Updater
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+# fields every layer may inherit from the global NeuralNetConfiguration
+INHERITABLE = ("activation", "weight_init", "updater", "l1", "l2", "dropout",
+               "bias_init", "dist")
+
+
+@dataclass
+class Layer:
+    """Base layer config. ``None`` hyperparameters inherit the network-level
+    defaults at build time (parity: NeuralNetConfiguration.Builder global
+    defaults, NeuralNetConfiguration.java:570)."""
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[tuple] = None            # for weight_init='distribution'
+    bias_init: Optional[float] = None
+    updater: Optional[Updater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None          # drop probability (NOT dl4j retain-prob)
+    constraints: Optional[tuple] = None      # e.g. ('maxnorm', 2.0)
+
+    # ---- config protocol -------------------------------------------------
+    def apply_defaults(self, defaults: Dict[str, Any]):
+        for f in INHERITABLE:
+            if hasattr(self, f) and getattr(self, f) is None and f in defaults:
+                setattr(self, f, defaults[f])
+
+    def set_n_in(self, input_type: InputType) -> None:
+        pass
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    # ---- runtime protocol ------------------------------------------------
+    def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
+        return {}
+
+    def init_state(self) -> Dict[str, Any]:
+        return {}
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    def has_params(self) -> bool:
+        return True
+
+    # dropout on the INPUT activations, matching the reference convention
+    # (BaseLayer.applyDropOutIfNecessary before preOutput)
+    def maybe_dropout(self, x, *, train, rng):
+        p = self.dropout
+        if not train or p is None or p <= 0.0 or rng is None:
+            return x
+        keep = 1.0 - p
+        m = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(m, x / keep, 0.0)
+
+    # ---- regularization: container sums these into the loss --------------
+    def reg_loss(self, params):
+        l1 = self.l1 or 0.0
+        l2 = self.l2 or 0.0
+        if (l1 == 0.0 and l2 == 0.0) or not params:
+            return 0.0
+        total = 0.0
+        for k, v in params.items():
+            if k.startswith("b") or k in ("beta", "gamma", "mean", "var"):
+                continue  # no l1/l2 on biases or norm params, like the reference
+            for vv in jax.tree_util.tree_leaves(v):
+                total = total + l1 * jnp.abs(vv).sum() + 0.5 * l2 * (vv ** 2).sum()
+        return total
+
+    def apply_constraints(self, params):
+        """Post-update parameter constraints (parity: nn/conf/constraint/*)."""
+        if not self.constraints or not params:
+            return params
+        kind = self.constraints[0]
+        arg = self.constraints[1] if len(self.constraints) > 1 else 1.0
+        out = dict(params)
+        for k, v in params.items():
+            if k.startswith("b") or isinstance(v, dict):
+                continue
+            if kind == "maxnorm":
+                axes = tuple(range(v.ndim - 1))
+                n = jnp.sqrt((v ** 2).sum(axis=axes, keepdims=True))
+                out[k] = v * jnp.clip(n, 0, arg) / jnp.maximum(n, 1e-8)
+            elif kind == "unitnorm":
+                axes = tuple(range(v.ndim - 1))
+                n = jnp.sqrt((v ** 2).sum(axis=axes, keepdims=True))
+                out[k] = v / jnp.maximum(n, 1e-8)
+            elif kind == "nonneg":
+                out[k] = jnp.maximum(v, 0.0)
+            elif kind == "minmaxnorm":
+                lo, hi = self.constraints[1], self.constraints[2]
+                axes = tuple(range(v.ndim - 1))
+                n = jnp.sqrt((v ** 2).sum(axis=axes, keepdims=True))
+                out[k] = v * jnp.clip(n, lo, hi) / jnp.maximum(n, 1e-8)
+        return out
+
+    # ---- serde -----------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Updater):
+                v = v.to_dict()
+            elif isinstance(v, Layer):  # wrappers (Bidirectional, Frozen)
+                v = v.to_dict()
+            elif isinstance(v, tuple):
+                v = list(v)
+            d[f.name] = v
+        d["@type"] = type(self).__name__
+        return d
+
+    @classmethod
+    def _from_dict_fields(cls, d):
+        d = dict(d)
+        d.pop("@type", None)
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for k, v in d.items():
+            if k not in fields:
+                continue
+            if k == "updater" and isinstance(v, dict):
+                v = Updater.from_dict(v)
+            elif isinstance(v, dict) and "@type" in v:
+                v = layer_from_dict(v)
+            elif isinstance(v, list):
+                v = tuple(v)
+            kwargs[k] = v
+        return cls(**kwargs)
+
+
+def layer_from_dict(d: Dict[str, Any]) -> Layer:
+    cls = LAYER_REGISTRY[d["@type"]]
+    return cls._from_dict_fields(d)
+
+
+def require_dims(layer, **dims):
+    """Validate that inferred/declared dims are set before init — catches
+    building a net without set_input_type and without explicit n_in."""
+    for k, v in dims.items():
+        if not v or v <= 0:
+            raise ValueError(
+                f"{type(layer).__name__}: {k}={v} is not set. Provide "
+                f"set_input_type(...) on the ListBuilder/GraphBuilder or set "
+                f"{k} explicitly on the layer.")
+
+
+def as_pair(v):
+    """Normalize an int-or-pair hyperparameter to a 2-tuple."""
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v)

@@ -1,0 +1,232 @@
+"""Core feed-forward layers.
+
+Parity: reference nn/conf/layers/DenseLayer.java, OutputLayer.java,
+LossLayer.java, ActivationLayer.java, DropoutLayer.java, EmbeddingLayer.java,
+ElementWiseMultiplicationLayer + nn/layers/feedforward/** impls. Param keys
+match the reference ("W", "b") for import compatibility
+(nn/params/DefaultParamInitializer.java).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, require_dims
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.losses import get_loss
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+@register_layer
+@dataclass
+class DenseLayer(Layer):
+    """Fully connected layer: y = act(x @ W + b). On 3d (B,T,C) input the
+    matmul is applied per timestep — one big (B*T, C) GEMM on the MXU
+    (the reference inserts an RnnToFeedForwardPreProcessor instead)."""
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size() if input_type.kind != "rnn" \
+                else input_type.size
+
+    def output_type(self, input_type):
+        if input_type.kind == "rnn":
+            return InputType.recurrent(self.n_out, input_type.timeseries_length)
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, dtype=jnp.float32):
+        require_dims(self, n_in=self.n_in, n_out=self.n_out)
+        p = {"W": init_weights(rng, (self.n_in, self.n_out),
+                               self.weight_init or "xavier", self.dist, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        if x.ndim > 2 and x.shape[-1] != self.n_in:
+            x = x.reshape(x.shape[0], -1)  # implicit CNN→FF flatten
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation or "identity")(y), state
+
+
+@register_layer
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (parity: nn/conf/layers/OutputLayer.java). The
+    container calls ``compute_score`` with labels during training."""
+    loss: str = "mcxent"
+
+    def compute_score(self, params, x, labels, mask=None, *, train=False, rng=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        if x.ndim > 2 and x.shape[-1] != self.n_in:
+            x = x.reshape(x.shape[0], -1)
+        pre = x @ params["W"]
+        if self.has_bias:
+            pre = pre + params["b"]
+        if pre.ndim == 3:  # (B,T,C) time-distributed loss
+            B, T, C = pre.shape
+            pre = pre.reshape(B * T, C)
+            labels = labels.reshape(B * T, -1)
+            if mask is not None:
+                mask = mask.reshape(B * T)
+        return get_loss(self.loss)(labels, pre, self.activation or "softmax", mask)
+
+
+@register_layer
+@dataclass
+class LossLayer(Layer):
+    """Loss-only head, no params (parity: nn/conf/layers/LossLayer.java)."""
+    loss: str = "mcxent"
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation or "identity")(x), state
+
+    def compute_score(self, params, x, labels, mask=None, *, train=False, rng=None):
+        return get_loss(self.loss)(labels, x, self.activation or "identity", mask)
+
+
+@register_layer
+@dataclass
+class ActivationLayer(Layer):
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation or "relu")(x), state
+
+
+@register_layer
+@dataclass
+class DropoutLayer(Layer):
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return self.maybe_dropout(x, train=train, rng=rng), state
+
+
+@register_layer
+@dataclass
+class EmbeddingLayer(Layer):
+    """Index → vector lookup (parity: nn/conf/layers/EmbeddingLayer.java).
+    Input: (B,) or (B,1) int indices. A gather, not a one-hot matmul —
+    XLA lowers this to a dynamic-slice, cheap on TPU."""
+    n_in: int = 0   # vocab size
+    n_out: int = 0
+    has_bias: bool = True
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, dtype=jnp.float32):
+        p = {"W": init_weights(rng, (self.n_in, self.n_out),
+                               self.weight_init or "xavier", self.dist, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        y = params["W"][idx]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation or "identity")(y), state
+
+
+@register_layer
+@dataclass
+class EmbeddingSequenceLayer(Layer):
+    """Sequence of indices → sequence of vectors: (B,T) → (B,T,E)."""
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = False
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.size or input_type.flat_size()
+
+    def output_type(self, input_type):
+        t = input_type.timeseries_length if input_type.kind == "rnn" else -1
+        return InputType.recurrent(self.n_out, t)
+
+    def init(self, rng, dtype=jnp.float32):
+        p = {"W": init_weights(rng, (self.n_in, self.n_out),
+                               self.weight_init or "xavier", self.dist, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = params["W"][idx]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation or "identity")(y), state
+
+
+@register_layer
+@dataclass
+class PReLULayer(Layer):
+    """Learned leaky-relu slope (parity: nn/conf/layers/PReLULayer later refs;
+    alpha shared per-feature)."""
+    n_in: int = 0
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size()
+
+    def init(self, rng, dtype=jnp.float32):
+        return {"alpha": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        a = params["alpha"]
+        shape = [1] * (x.ndim - 1) + [a.shape[0]]
+        a = a.reshape(shape)
+        return jnp.where(x >= 0, x, a * x), state
+
+
+@register_layer
+@dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """y = act(x * w + b), elementwise learned scaling
+    (parity: nn/conf/layers/misc/ElementWiseMultiplicationLayer)."""
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size()
+        self.n_out = self.n_in
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out or self.n_in)
+
+    def init(self, rng, dtype=jnp.float32):
+        return {"W": jnp.ones((self.n_in,), dtype),
+                "b": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        y = x * params["W"] + params["b"]
+        return get_activation(self.activation or "identity")(y), state

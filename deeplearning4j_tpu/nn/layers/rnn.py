@@ -1,0 +1,340 @@
+"""Recurrent layers.
+
+Parity: reference nn/conf/layers/GravesLSTM.java, LSTM, GravesBidirectionalLSTM,
+nn/layers/recurrent/LSTMHelpers.java:68,392 (shared fwd/bwd math) and the
+fused cuDNN RNN path (deeplearning4j-cuda CudnnLSTMHelper.java:588).
+
+TPU design: the input-to-gate projection for the WHOLE sequence is one large
+(B*T, C)×(C, 4H) GEMM done outside the time loop (MXU-friendly); only the
+recurrent h→gates GEMM lives inside ``lax.scan``. Backward through time is
+autodiff through scan — no hand-written BPTT. Param keys follow the reference
+(``W`` input weights, ``RW`` recurrent weights, ``b`` bias,
+nn/params/LSTMParamInitializer.java).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, require_dims
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.losses import get_loss
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.core import OutputLayer
+
+
+@register_layer
+@dataclass
+class LSTM(Layer):
+    """Standard LSTM (no peepholes). Gate order: [i, f, o, g] — matches the
+    reference's IFOG layout (LSTMParamInitializer)."""
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.size or input_type.flat_size()
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def init(self, rng, dtype=jnp.float32):
+        require_dims(self, n_in=self.n_in, n_out=self.n_out)
+        r1, r2 = jax.random.split(rng)
+        H = self.n_out
+        b = jnp.zeros((4 * H,), dtype)
+        b = b.at[H:2 * H].set(self.forget_gate_bias_init)
+        return {
+            "W": init_weights(r1, (self.n_in, 4 * H), self.weight_init or "xavier",
+                              self.dist, dtype, fan_in=self.n_in, fan_out=H),
+            "RW": init_weights(r2, (H, 4 * H), self.weight_init or "xavier",
+                               self.dist, dtype, fan_in=H, fan_out=H),
+            "b": b,
+        }
+
+    def _gates(self, params):
+        return params["W"], params["RW"], params["b"]
+
+    def _cell(self, params, gate_in_t, h, c, mask_t):
+        """One step. gate_in_t: (B, 4H) precomputed x@W + b."""
+        H = self.n_out
+        act = get_activation(self.activation or "tanh")
+        gact = get_activation(self.gate_activation)
+        z = gate_in_t + h @ params["RW"]
+        i = gact(z[:, 0 * H:1 * H])
+        f = gact(z[:, 1 * H:2 * H])
+        o = gact(z[:, 2 * H:3 * H])
+        g = act(z[:, 3 * H:4 * H])
+        c_new = f * c + i * g
+        h_new = o * act(c_new)
+        if mask_t is not None:
+            m = mask_t[:, None]
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+        return h_new, c_new
+
+    def _scan(self, params, x, mask, h0, c0):
+        B, T, _ = x.shape
+        gate_in = x.reshape(B * T, -1) @ params["W"] + params["b"]
+        gate_in = gate_in.reshape(B, T, -1).transpose(1, 0, 2)  # (T, B, 4H)
+        mask_t = None if mask is None else mask.transpose(1, 0)
+
+        def step(carry, inp):
+            h, c = carry
+            if mask is None:
+                g = inp
+                h, c = self._cell(params, g, h, c, None)
+            else:
+                g, m = inp
+                h, c = self._cell(params, g, h, c, m)
+            return (h, c), h
+
+        xs = gate_in if mask is None else (gate_in, mask_t)
+        (hT, cT), hs = lax.scan(step, (h0, c0), xs)
+        return hs.transpose(1, 0, 2), (hT, cT)  # (B, T, H)
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        B = x.shape[0]
+        h0 = jnp.zeros((B, self.n_out), x.dtype)
+        c0 = jnp.zeros((B, self.n_out), x.dtype)
+        y, _ = self._scan(params, x, mask, h0, c0)
+        return y, state
+
+    def apply_with_carry(self, params, x, carry=None, mask=None):
+        """Stateful-inference step (parity: rnnTimeStep,
+        MultiLayerNetwork.java:2209 rnnActivateUsingStoredState)."""
+        B = x.shape[0]
+        if carry is None:
+            carry = (jnp.zeros((B, self.n_out), x.dtype),
+                     jnp.zeros((B, self.n_out), x.dtype))
+        y, new_carry = self._scan(params, x, mask, carry[0], carry[1])
+        return y, new_carry
+
+
+@register_layer
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013 variant — parity:
+    nn/conf/layers/GravesLSTM.java). Peephole weights key: 'pW' (3H,)."""
+
+    def init(self, rng, dtype=jnp.float32):
+        p = super().init(rng, dtype)
+        p["pW"] = jnp.zeros((3 * self.n_out,), dtype)
+        return p
+
+    def _cell(self, params, gate_in_t, h, c, mask_t):
+        H = self.n_out
+        act = get_activation(self.activation or "tanh")
+        gact = get_activation(self.gate_activation)
+        pw = params["pW"]
+        z = gate_in_t + h @ params["RW"]
+        i = gact(z[:, 0 * H:1 * H] + c * pw[0 * H:1 * H])
+        f = gact(z[:, 1 * H:2 * H] + c * pw[1 * H:2 * H])
+        g = act(z[:, 3 * H:4 * H])
+        c_new = f * c + i * g
+        o = gact(z[:, 2 * H:3 * H] + c_new * pw[2 * H:3 * H])
+        h_new = o * act(c_new)
+        if mask_t is not None:
+            m = mask_t[:, None]
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+        return h_new, c_new
+
+
+@register_layer
+@dataclass
+class SimpleRnn(Layer):
+    """Vanilla RNN: h_t = act(x_t W + h_{t-1} RW + b)."""
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.size or input_type.flat_size()
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def init(self, rng, dtype=jnp.float32):
+        r1, r2 = jax.random.split(rng)
+        return {
+            "W": init_weights(r1, (self.n_in, self.n_out),
+                              self.weight_init or "xavier", self.dist, dtype),
+            "RW": init_weights(r2, (self.n_out, self.n_out),
+                               self.weight_init or "xavier", self.dist, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        act = get_activation(self.activation or "tanh")
+        B, T, _ = x.shape
+        gate_in = (x.reshape(B * T, -1) @ params["W"] + params["b"]).reshape(B, T, -1)
+        gate_in = gate_in.transpose(1, 0, 2)
+        mask_t = None if mask is None else mask.transpose(1, 0)
+
+        def step(h, inp):
+            if mask is None:
+                g = inp
+                h_new = act(g + h @ params["RW"])
+            else:
+                g, m = inp
+                h_new = act(g + h @ params["RW"])
+                h_new = m[:, None] * h_new + (1 - m[:, None]) * h
+            return h_new, h_new
+
+        xs = gate_in if mask is None else (gate_in, mask_t)
+        h0 = jnp.zeros((B, self.n_out), x.dtype)
+        _, hs = lax.scan(step, h0, xs)
+        return hs.transpose(1, 0, 2), state
+
+
+@register_layer
+@dataclass
+class Bidirectional(Layer):
+    """Bidirectional wrapper (parity: nn/conf/layers/recurrent/Bidirectional).
+    mode: concat | add | mul | ave."""
+    fwd: Optional[Layer] = None
+    mode: str = "concat"
+
+    def set_n_in(self, input_type):
+        self.fwd.set_n_in(input_type)
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        if self.fwd is not None:
+            self.fwd.apply_defaults(defaults)
+
+    def output_type(self, input_type):
+        ot = self.fwd.output_type(input_type)
+        if self.mode == "concat":
+            return InputType.recurrent(ot.size * 2, ot.timeseries_length)
+        return ot
+
+    def init(self, rng, dtype=jnp.float32):
+        r1, r2 = jax.random.split(rng)
+        return {"fwd": self.fwd.init(r1, dtype), "bwd": self.fwd.init(r2, dtype)}
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        yf, _ = self.fwd.apply(params["fwd"], x, None, train=train, rng=rng, mask=mask)
+        xr = jnp.flip(x, axis=1)
+        mr = None if mask is None else jnp.flip(mask, axis=1)
+        yb, _ = self.fwd.apply(params["bwd"], xr, None, train=train, rng=rng, mask=mr)
+        yb = jnp.flip(yb, axis=1)
+        if self.mode == "concat":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        if self.mode == "add":
+            return yf + yb, state
+        if self.mode == "mul":
+            return yf * yb, state
+        if self.mode == "ave":
+            return 0.5 * (yf + yb), state
+        raise ValueError(self.mode)
+
+
+@register_layer
+@dataclass
+class GravesBidirectionalLSTM(Layer):
+    """Legacy fused bidirectional Graves LSTM
+    (parity: nn/conf/layers/GravesBidirectionalLSTM.java)."""
+    n_in: int = 0
+    n_out: int = 0
+
+    def __post_init__(self):
+        self._bi = None
+
+    def _build(self):
+        if self._bi is None:
+            inner = GravesLSTM(n_in=self.n_in, n_out=self.n_out,
+                               activation=self.activation,
+                               weight_init=self.weight_init, dist=self.dist)
+            self._bi = Bidirectional(fwd=inner, mode="add")
+        return self._bi
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.size or input_type.flat_size()
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def init(self, rng, dtype=jnp.float32):
+        return self._build().init(rng, dtype)
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return self._build().apply(params, x, state, train=train, rng=rng, mask=mask)
+
+
+@register_layer
+@dataclass
+class LastTimeStep(Layer):
+    """Wrapper: run inner RNN layer, keep only the last (unmasked) step."""
+    fwd: Optional[Layer] = None
+
+    def set_n_in(self, input_type):
+        self.fwd.set_n_in(input_type)
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        if self.fwd is not None:
+            self.fwd.apply_defaults(defaults)
+
+    def output_type(self, input_type):
+        ot = self.fwd.output_type(input_type)
+        return InputType.feed_forward(ot.size)
+
+    def init(self, rng, dtype=jnp.float32):
+        return self.fwd.init(rng, dtype)
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        y, _ = self.fwd.apply(params, x, None, train=train, rng=rng, mask=mask)
+        if mask is None:
+            return y[:, -1, :], state
+        idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+        return y[jnp.arange(y.shape[0]), idx, :], state
+
+
+@register_layer
+@dataclass
+class RnnOutputLayer(OutputLayer):
+    """Time-distributed output layer over (B,T,C)
+    (parity: nn/conf/layers/RnnOutputLayer.java)."""
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation or "softmax")(y), state
+
+
+@register_layer
+@dataclass
+class RnnLossLayer(Layer):
+    """Parameterless time-distributed loss."""
+    loss: str = "mcxent"
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation or "identity")(x), state
+
+    def compute_score(self, params, x, labels, mask=None, *, train=False, rng=None):
+        B, T = x.shape[0], x.shape[1]
+        xf = x.reshape(B * T, -1)
+        lf = labels.reshape(B * T, -1)
+        mf = None if mask is None else mask.reshape(B * T)
+        return get_loss(self.loss)(lf, xf, self.activation or "identity", mf)

@@ -1,0 +1,338 @@
+"""Special layers: global pooling, autoencoders, VAE, center loss, YOLO, frozen.
+
+Parity: reference nn/conf/layers/GlobalPoolingLayer.java,
+nn/layers/variational/VariationalAutoencoder.java:51 (1,163 LoC),
+nn/conf/layers/CenterLossOutputLayer.java,
+nn/conf/layers/objdetect/Yolo2OutputLayer.java (721 LoC impl),
+nn/layers/FrozenLayer.java.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.losses import get_loss
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+
+
+@register_layer
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Pool over time (B,T,C)→(B,C) or space (B,H,W,C)→(B,C). Mask-aware for
+    variable-length sequences (parity: GlobalPoolingLayer.java)."""
+    pooling_type: str = "max"   # max | avg | sum | pnorm
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type):
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        if x.ndim == 3:
+            axes = (1,)
+        elif x.ndim == 4:
+            axes = (1, 2)
+        else:
+            return x, state
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None]
+            if self.pooling_type == "max":
+                y = jnp.where(m > 0, x, -jnp.inf).max(axis=1)
+            elif self.pooling_type == "sum":
+                y = (x * m).sum(axis=1)
+            elif self.pooling_type == "avg":
+                y = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+            else:
+                p = float(self.pnorm)
+                y = ((jnp.abs(x) ** p * m).sum(axis=1)) ** (1.0 / p)
+            return y, state
+        if self.pooling_type == "max":
+            y = x.max(axis=axes)
+        elif self.pooling_type == "sum":
+            y = x.sum(axis=axes)
+        elif self.pooling_type == "avg":
+            y = x.mean(axis=axes)
+        else:
+            p = float(self.pnorm)
+            y = (jnp.abs(x) ** p).sum(axis=axes) ** (1.0 / p)
+        return y, state
+
+
+@register_layer
+@dataclass
+class AutoEncoder(Layer):
+    """Denoising autoencoder (parity: nn/conf/layers/AutoEncoder.java,
+    nn/layers/feedforward/autoencoder/AutoEncoder.java). ``apply`` returns the
+    encoding; ``compute_score`` adds corruption + reconstruction loss for
+    layerwise pretraining."""
+    n_in: int = 0
+    n_out: int = 0
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, dtype=jnp.float32):
+        r1, r2 = jax.random.split(rng)
+        return {
+            "W": init_weights(r1, (self.n_in, self.n_out),
+                              self.weight_init or "xavier", self.dist, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),  # visible bias for decode
+        }
+
+    def _encode(self, params, x):
+        return get_activation(self.activation or "sigmoid")(x @ params["W"] + params["b"])
+
+    def _decode(self, params, h):
+        return get_activation(self.activation or "sigmoid")(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return self._encode(params, x), state
+
+    def compute_score(self, params, x, labels=None, mask=None, *, train=False, rng=None):
+        if train and rng is not None and self.corruption_level > 0:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            xc = jnp.where(keep, x, 0.0)
+        else:
+            xc = x
+        recon = self._decode(params, self._encode(params, xc))
+        return get_loss(self.loss)(x, recon, "identity", mask)
+
+
+@register_layer
+@dataclass
+class VariationalAutoencoder(Layer):
+    """VAE (parity: nn/layers/variational/VariationalAutoencoder.java:51).
+    Gaussian q(z|x); pluggable reconstruction distribution via ``recon``:
+    'gaussian' | 'bernoulli' | 'mse'. ``apply`` returns the latent mean
+    (matches reference activate() semantics); ``compute_score`` = -ELBO."""
+    n_in: int = 0
+    n_out: int = 0                        # latent size nZ
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    recon: str = "bernoulli"
+    pzx_activation: str = "identity"
+    num_samples: int = 1
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, dtype=jnp.float32):
+        act_in = self.n_in
+        p = {"enc": [], "dec": []}
+        keys = jax.random.split(rng, len(self.encoder_layer_sizes) +
+                                len(self.decoder_layer_sizes) + 4)
+        ki = 0
+        for h in self.encoder_layer_sizes:
+            p["enc"].append({
+                "W": init_weights(keys[ki], (act_in, h),
+                                  self.weight_init or "xavier", self.dist, dtype),
+                "b": jnp.zeros((h,), dtype)})
+            act_in = h
+            ki += 1
+        p["zW_mean"] = init_weights(keys[ki], (act_in, self.n_out),
+                                    self.weight_init or "xavier", self.dist, dtype)
+        p["zb_mean"] = jnp.zeros((self.n_out,), dtype)
+        ki += 1
+        p["zW_logvar"] = init_weights(keys[ki], (act_in, self.n_out),
+                                      self.weight_init or "xavier", self.dist, dtype)
+        p["zb_logvar"] = jnp.zeros((self.n_out,), dtype)
+        ki += 1
+        act_in = self.n_out
+        for h in self.decoder_layer_sizes:
+            p["dec"].append({
+                "W": init_weights(keys[ki], (act_in, h),
+                                  self.weight_init or "xavier", self.dist, dtype),
+                "b": jnp.zeros((h,), dtype)})
+            act_in = h
+            ki += 1
+        p["xW"] = init_weights(keys[ki], (act_in, self.n_in),
+                               self.weight_init or "xavier", self.dist, dtype)
+        p["xb"] = jnp.zeros((self.n_in,), dtype)
+        return p
+
+    def _encode(self, params, x):
+        act = get_activation(self.activation or "tanh")
+        h = x
+        for lp in params["enc"]:
+            h = act(h @ lp["W"] + lp["b"])
+        mean = get_activation(self.pzx_activation)(h @ params["zW_mean"] + params["zb_mean"])
+        logvar = h @ params["zW_logvar"] + params["zb_logvar"]
+        return mean, logvar
+
+    def _decode(self, params, z):
+        act = get_activation(self.activation or "tanh")
+        h = z
+        for lp in params["dec"]:
+            h = act(h @ lp["W"] + lp["b"])
+        return h @ params["xW"] + params["xb"]
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    def reconstruct(self, params, x):
+        mean, _ = self._encode(params, x)
+        logits = self._decode(params, mean)
+        if self.recon == "bernoulli":
+            return jax.nn.sigmoid(logits)
+        return logits
+
+    def generate(self, params, z):
+        logits = self._decode(params, z)
+        if self.recon == "bernoulli":
+            return jax.nn.sigmoid(logits)
+        return logits
+
+    def compute_score(self, params, x, labels=None, mask=None, *, train=False, rng=None):
+        mean, logvar = self._encode(params, x)
+        if rng is not None and train:
+            eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        else:
+            eps = jnp.zeros_like(mean)
+        z = mean + jnp.exp(0.5 * logvar) * eps
+        logits = self._decode(params, z)
+        if self.recon == "bernoulli":
+            xcl = jnp.clip(x, 0.0, 1.0)
+            rec = jnp.maximum(logits, 0) - logits * xcl + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            rec = rec.sum(axis=-1)
+        else:  # gaussian / mse
+            rec = 0.5 * ((x - logits) ** 2).sum(axis=-1)
+        kl = -0.5 * (1 + logvar - mean ** 2 - jnp.exp(logvar)).sum(axis=-1)
+        return (rec + kl).mean()
+
+
+@register_layer
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (parity: nn/conf/layers/CenterLossOutputLayer).
+    Class centers are trainable params pulled toward features; total loss =
+    primary + lambda * centerloss."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init(self, rng, dtype=jnp.float32):
+        p = super().init(rng, dtype)
+        p["centers"] = jnp.zeros((self.n_out, self.n_in), dtype)
+        return p
+
+    def compute_score(self, params, x, labels, mask=None, *, train=False, rng=None):
+        base = super().compute_score(
+            {k: v for k, v in params.items() if k != "centers"},
+            x, labels, mask, train=train, rng=rng)
+        cls = jnp.argmax(labels, axis=-1)
+        centers = params["centers"][cls]
+        cl = 0.5 * ((x - centers) ** 2).sum(axis=-1).mean()
+        return base + self.lambda_ * cl
+
+
+@register_layer
+@dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection loss (parity: nn/conf/layers/objdetect/
+    Yolo2OutputLayer + nn/layers/objdetect/Yolo2OutputLayer.java, 721 LoC).
+
+    Input: (B, H, W, A*(5+C)) raw activations (NHWC; A = #anchors).
+    Labels: (B, H, W, A*(5+C)) with the same layout: per anchor
+    [tx, ty, tw, th, obj, class-one-hot]. Cells with obj=0 contribute only
+    no-object confidence loss.
+    """
+    anchors: Tuple[Tuple[float, float], ...] = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+    n_classes: int = 0
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return x, state
+
+    def _split(self, x):
+        A = len(self.anchors)
+        B, H, W, _ = x.shape
+        x = x.reshape(B, H, W, A, 5 + self.n_classes)
+        xy = jax.nn.sigmoid(x[..., 0:2])
+        wh = x[..., 2:4]
+        obj = jax.nn.sigmoid(x[..., 4])
+        cls = x[..., 5:]
+        return xy, wh, obj, cls
+
+    def compute_score(self, params, x, labels, mask=None, *, train=False, rng=None):
+        pxy, pwh, pobj, pcls = self._split(x)
+        A = len(self.anchors)
+        B, H, W, _ = labels.shape
+        lab = labels.reshape(B, H, W, A, 5 + self.n_classes)
+        txy, twh, tobj, tcls = lab[..., 0:2], lab[..., 2:4], lab[..., 4], lab[..., 5:]
+        coord = ((pxy - txy) ** 2).sum(-1) + ((pwh - twh) ** 2).sum(-1)
+        coord = (coord * tobj).sum() / B
+        obj_loss = (tobj * (pobj - 1.0) ** 2).sum() / B
+        noobj_loss = ((1 - tobj) * pobj ** 2).sum() / B
+        logp = jax.nn.log_softmax(pcls, axis=-1)
+        cls_loss = (-(tcls * logp).sum(-1) * tobj).sum() / B
+        return (self.lambda_coord * coord + obj_loss +
+                self.lambda_no_obj * noobj_loss + cls_loss)
+
+
+@register_layer
+@dataclass
+class FrozenLayer(Layer):
+    """Wrapper freezing inner params (parity: nn/layers/FrozenLayer.java;
+    used by transfer learning). Gradient is cut with stop_gradient and the
+    container also excludes these params from the updater."""
+    inner: Optional[Layer] = None
+
+    def set_n_in(self, input_type):
+        self.inner.set_n_in(input_type)
+
+    def apply_defaults(self, defaults):
+        if self.inner is not None:
+            self.inner.apply_defaults(defaults)
+
+    def output_type(self, input_type):
+        return self.inner.output_type(input_type)
+
+    def init(self, rng, dtype=jnp.float32):
+        return self.inner.init(rng, dtype)
+
+    def init_state(self):
+        return self.inner.init_state()
+
+    def has_params(self):
+        return self.inner.has_params()
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        # frozen layers run in inference mode (no dropout, fixed BN stats)
+        y, _ = self.inner.apply(frozen, x, state, train=False, rng=rng, mask=mask)
+        return y, state
+
+    def compute_score(self, params, x, labels, mask=None, *, train=False, rng=None):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        return self.inner.compute_score(frozen, x, labels, mask, train=False, rng=rng)

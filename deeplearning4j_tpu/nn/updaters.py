@@ -1,0 +1,224 @@
+"""Updaters (optimizer update rules) and learning-rate schedules.
+
+Parity surface: the reference's ``IUpdater`` set applied per updater-block
+(nn/conf/Updater.java:12 — SGD, ADAM, ADAMAX, ADADELTA, NESTEROVS, NADAM,
+ADAGRAD, RMSPROP, NONE) plus ``LearningRatePolicy`` schedules
+(nn/conf/LearningRatePolicy.java: Exponential/Inverse/Poly/Sigmoid/Step/
+Schedule). Here each updater is a small dataclass that lowers to an optax
+``GradientTransformation``; updater state is an immutable pytree carried
+through the jit'd train step (replaces the flat mutable updater-state array of
+BaseMultiLayerUpdater.java:38).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Dict, Any
+
+import optax
+
+
+# ---------------------------------------------------------------- schedules
+
+@dataclass(frozen=True)
+class Schedule:
+    """Learning-rate schedule. kind: constant|exponential|inverse|poly|sigmoid|
+    step|map. Iteration-indexed, like the reference's LearningRatePolicy."""
+    kind: str = "constant"
+    initial: float = 1e-3
+    decay_rate: float = 0.99
+    power: float = 1.0
+    steps: float = 1000.0
+    gamma: float = 0.99
+    max_iter: float = 10000.0
+    values: Optional[Dict[int, float]] = None  # for 'map'
+
+    def to_optax(self):
+        k = self.kind
+        if k == "constant":
+            return self.initial
+        if k == "exponential":
+            # lr = initial * decay_rate^iter
+            return lambda it: self.initial * (self.decay_rate ** it)
+        if k == "inverse":
+            return lambda it: self.initial / ((1.0 + self.gamma * it) ** self.power)
+        if k == "poly":
+            return lambda it: self.initial * (
+                (1.0 - (it / self.max_iter).clip(0.0, 1.0) if hasattr(it, "clip")
+                 else max(0.0, min(1.0, 1.0 - it / self.max_iter))) ** self.power)
+        if k == "sigmoid":
+            import jax.numpy as jnp
+            return lambda it: self.initial / (1.0 + jnp.exp(-self.gamma * (it - self.steps)))
+        if k == "step":
+            return lambda it: self.initial * (self.decay_rate ** (it // self.steps))
+        if k == "map":
+            boundaries = sorted((self.values or {}).items())
+            import jax.numpy as jnp
+
+            def sched(it):
+                lr = self.initial
+                for b, v in boundaries:
+                    lr = jnp.where(it >= b, v, lr)
+                return lr
+            return sched
+        raise ValueError(f"Unknown schedule kind {k}")
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        if d.get("values") is not None:
+            d["values"] = {int(k): v for k, v in d["values"].items()}
+        return Schedule(**d)
+
+
+def _lr(self):
+    if self.schedule is not None:
+        return self.schedule.to_optax()
+    return self.learning_rate
+
+
+# ---------------------------------------------------------------- updaters
+
+@dataclass(frozen=True)
+class Updater:
+    """Base updater config; subclasses lower to optax."""
+    learning_rate: float = 1e-3
+    schedule: Optional[Schedule] = None
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@type"] = type(self).__name__
+        if self.schedule is not None:
+            d["schedule"] = self.schedule.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = UPDATERS[d.pop("@type")]
+        if d.get("schedule") is not None:
+            d["schedule"] = Schedule.from_dict(d["schedule"])
+        return cls(**d)
+
+
+@dataclass(frozen=True)
+class Sgd(Updater):
+    def to_optax(self):
+        return optax.sgd(_lr(self))
+
+
+@dataclass(frozen=True)
+class Nesterovs(Updater):
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def to_optax(self):
+        return optax.sgd(_lr(self), momentum=self.momentum, nesterov=True)
+
+
+@dataclass(frozen=True)
+class Adam(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adam(_lr(self), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclass(frozen=True)
+class AdaMax(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adamax(_lr(self), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclass(frozen=True)
+class NAdam(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.nadam(_lr(self), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclass(frozen=True)
+class AdaGrad(Updater):
+    learning_rate: float = 0.1
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adagrad(_lr(self), eps=self.epsilon)
+
+
+@dataclass(frozen=True)
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        # reference AdaDelta has no lr (lr = 1)
+        return optax.adadelta(learning_rate=1.0, rho=self.rho, eps=self.epsilon)
+
+
+@dataclass(frozen=True)
+class RmsProp(Updater):
+    learning_rate: float = 0.1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.rmsprop(_lr(self), decay=self.rms_decay, eps=self.epsilon)
+
+
+@dataclass(frozen=True)
+class AmsGrad(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.amsgrad(_lr(self), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclass(frozen=True)
+class NoOp(Updater):
+    """Updater NONE: the raw gradient is applied unmodified (params -= grad),
+    matching the reference's NoOp pass-through semantics."""
+
+    def to_optax(self):
+        return optax.sgd(1.0)
+
+
+UPDATERS = {c.__name__: c for c in
+            [Sgd, Nesterovs, Adam, AdaMax, NAdam, AdaGrad, AdaDelta, RmsProp,
+             AmsGrad, NoOp]}
+
+
+def make_gradient_transform(updater: Updater,
+                            grad_norm_threshold: Optional[float] = None,
+                            grad_clip_value: Optional[float] = None,
+                            l2: float = 0.0) -> optax.GradientTransformation:
+    """Compose clipping / weight decay / updater, matching the reference's
+    order of operations (BaseOptimizer.updateGradientAccordingToParams:
+    L2 added to gradient, then clipping, then updater)."""
+    chain = []
+    if l2 and l2 > 0:
+        chain.append(optax.add_decayed_weights(l2))
+    if grad_clip_value:
+        chain.append(optax.clip(grad_clip_value))
+    if grad_norm_threshold:
+        chain.append(optax.clip_by_global_norm(grad_norm_threshold))
+    chain.append(updater.to_optax())
+    return optax.chain(*chain) if len(chain) > 1 else chain[0]

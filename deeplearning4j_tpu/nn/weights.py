@@ -1,0 +1,111 @@
+"""Weight initialization schemes.
+
+Parity surface: the reference's ``WeightInit`` enum (20 schemes,
+deeplearning4j-nn/.../nn/weights/WeightInit.java:68) and ``WeightInitUtil``.
+Implemented as pure functions of a jax PRNG key — fully deterministic and
+reproducible across hosts, unlike the reference's shared java.util.Random.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape, fan_in=None, fan_out=None):
+    """fan_in/fan_out for a weight shape. Dense: (in, out). Conv (our NHWC
+    HWIO layout): (h, w, in, out) → fan_in = h*w*in, fan_out = h*w*out —
+    matches reference WeightInitUtil conventions."""
+    if fan_in is not None and fan_out is not None:
+        return float(fan_in), float(fan_out)
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    receptive = 1
+    for s in shape[:-2]:
+        receptive *= s
+    return float(receptive * shape[-2]), float(receptive * shape[-1])
+
+
+def init_weights(rng, shape, scheme="xavier", distribution=None, dtype=jnp.float32,
+                 fan_in=None, fan_out=None):
+    """Initialize a weight array.
+
+    scheme: one of the reference's WeightInit scheme names (case-insensitive).
+    distribution: (kind, *args) used when scheme == 'distribution',
+        e.g. ("normal", mean, std) or ("uniform", lo, hi).
+    """
+    scheme = str(scheme).lower()
+    fi, fo = _fans(shape, fan_in, fan_out)
+    n = fi + fo
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY weight init requires a square 2d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "normal":
+        # reference NORMAL: N(0, 1/sqrt(fan_in))
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fi)
+    if scheme == "lecun_normal":
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(1.0 / fi)
+    if scheme == "lecun_uniform":
+        b = jnp.sqrt(3.0 / fi)
+        return jax.random.uniform(rng, shape, dtype, -b, b)
+    if scheme == "uniform":
+        a = jnp.sqrt(1.0 / fi)
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == "xavier":
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(2.0 / n)
+    if scheme == "xavier_uniform":
+        b = jnp.sqrt(6.0 / n)
+        return jax.random.uniform(rng, shape, dtype, -b, b)
+    if scheme == "xavier_fan_in":
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fi)
+    if scheme == "xavier_legacy":
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(shape[0] * shape[-1])
+    if scheme == "relu":
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(2.0 / fi)
+    if scheme == "relu_uniform":
+        b = jnp.sqrt(6.0 / fi)
+        return jax.random.uniform(rng, shape, dtype, -b, b)
+    if scheme == "sigmoid_uniform":
+        b = 4.0 * jnp.sqrt(6.0 / n)
+        return jax.random.uniform(rng, shape, dtype, -b, b)
+    if scheme in ("var_scaling_normal_fan_in", "varscalingnormalfanin"):
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(1.0 / fi)
+    if scheme in ("var_scaling_normal_fan_out", "varscalingnormalfanout"):
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(1.0 / fo)
+    if scheme in ("var_scaling_normal_fan_avg", "varscalingnormalfanavg"):
+        return jax.random.normal(rng, shape, dtype) * jnp.sqrt(2.0 / n)
+    if scheme in ("var_scaling_uniform_fan_in", "varscalinguniformfanin"):
+        b = jnp.sqrt(3.0 / fi)
+        return jax.random.uniform(rng, shape, dtype, -b, b)
+    if scheme in ("var_scaling_uniform_fan_out", "varscalinguniformfanout"):
+        b = jnp.sqrt(3.0 / fo)
+        return jax.random.uniform(rng, shape, dtype, -b, b)
+    if scheme in ("var_scaling_uniform_fan_avg", "varscalinguniformfanavg"):
+        b = jnp.sqrt(6.0 / n)
+        return jax.random.uniform(rng, shape, dtype, -b, b)
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("scheme='distribution' requires a distribution tuple")
+        kind = str(distribution[0]).lower()
+        args = distribution[1:]
+        if kind == "normal" or kind == "gaussian":
+            mean, std = (args + (0.0, 1.0))[:2] if args else (0.0, 1.0)
+            return mean + std * jax.random.normal(rng, shape, dtype)
+        if kind == "uniform":
+            lo, hi = args if len(args) == 2 else (-1.0, 1.0)
+            return jax.random.uniform(rng, shape, dtype, lo, hi)
+        if kind == "constant":
+            return jnp.full(shape, args[0], dtype)
+        if kind == "truncated_normal":
+            mean, std = (args + (0.0, 1.0))[:2] if args else (0.0, 1.0)
+            return mean + std * jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype)
+        raise ValueError(f"Unknown distribution kind '{kind}'")
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

@@ -1,0 +1,121 @@
+"""End-to-end smoke tests: build, fit, eval, serialize tiny networks."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, OutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, LSTM, RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.fetchers import load_iris
+
+
+def iris_net():
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(Adam(1e-2))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def test_mlp_learns_iris():
+    x, y = load_iris()
+    net = MultiLayerNetwork(iris_net()).init()
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    for _ in range(120):
+        net.fit(ds)
+    assert net.score(ds) < s0 * 0.5
+    ev = net.evaluate(ds)
+    assert ev.accuracy() > 0.9
+
+
+def test_conv_net_trains():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=3, activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=2, stride=2))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 12, 12, 1).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.randint(0, 5, 8)]
+    s0 = net.score(x=x, y=y)
+    for _ in range(30):
+        net.fit(x, y)
+    assert np.isfinite(net.get_score())
+    assert net.score(x=x, y=y) < s0
+
+
+def test_lstm_sequence_classification():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(LSTM(n_out=12, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(6, 7, 5).astype(np.float32)
+    y = np.zeros((6, 7, 2), np.float32)
+    y[:, :, 0] = 1
+    s0 = net.score(x=x, y=y)
+    for _ in range(25):
+        net.fit(x, y)
+    assert net.score(x=x, y=y) < s0
+
+
+def test_output_shapes():
+    net = MultiLayerNetwork(iris_net()).init()
+    out = net.output(np.random.rand(10, 4).astype(np.float32))
+    assert out.shape == (10, 3)
+    assert np.allclose(np.asarray(out).sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_summary_and_params():
+    net = MultiLayerNetwork(iris_net()).init()
+    assert net.num_params() == 4 * 16 + 16 + 16 * 3 + 3
+    assert "DenseLayer" in net.summary()
+
+
+def test_serialization_roundtrip(tmp_path):
+    x, y = load_iris()
+    net = MultiLayerNetwork(iris_net()).init()
+    net.fit(DataSet(x, y))
+    p = tmp_path / "model.zip"
+    net.save(str(p))
+    net2 = MultiLayerNetwork.load(str(p))
+    out1 = np.asarray(net.output(x[:5]))
+    out2 = np.asarray(net2.output(x[:5]))
+    assert np.allclose(out1, out2, atol=1e-6)
+    # resumes training identically (updater state round-trip)
+    net.fit(DataSet(x, y))
+    net2.fit(DataSet(x, y))
+    assert np.allclose(np.asarray(net.output(x[:5])),
+                       np.asarray(net2.output(x[:5])), atol=1e-5)
+
+
+def test_config_json_roundtrip():
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    conf = iris_net()
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    net = MultiLayerNetwork(conf2).init()
+    assert net.num_params() > 0
