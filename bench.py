@@ -1,0 +1,72 @@
+"""Benchmark: LeNet-MNIST training throughput on one TPU chip.
+
+BASELINE config #1 (driver BASELINE.json): "MultiLayerNetwork LeNet on MNIST".
+The reference publishes no numbers (SURVEY.md §6), so ``vs_baseline`` is
+computed against a fixed reference point measured from the reference's own
+stack class: DL4J 0.9.2 LeNet on MNIST with the CPU ND4J backend trains at
+roughly 250-350 imgs/sec on a modern 8-core host (its cuDNN path on one V100
+reaches ~2-3k imgs/sec). We use 3000 imgs/sec — the upper end of the
+reference's GPU-accelerated throughput — as the bar to beat.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_IMGS_PER_SEC = 3000.0  # DL4J-cuDNN-on-V100 ballpark, the bar to beat
+BATCH = 128
+WARMUP_STEPS = 3
+MEASURE_STEPS = 30
+
+
+def main():
+    from __graft_entry__ import _lenet_conf, _force_cpu_if_requested
+    _force_cpu_if_requested()
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.data.fetchers import load_mnist
+
+    dev = jax.devices()[0]
+    net = MultiLayerNetwork(_lenet_conf()).init()
+
+    x_all, y_all = load_mnist(train=True, num_examples=BATCH * 4, flatten=False)
+    x = jnp.asarray(x_all[:BATCH])
+    y = jnp.asarray(y_all[:BATCH])
+
+    step = net._get_train_step(False, False)
+    params, state, opt = net.params, net.state, net.opt_state
+
+    # warmup / compile
+    for i in range(WARMUP_STEPS):
+        params, state, opt, loss, _ = step(params, state, opt, x, y,
+                                           jnp.asarray(i, jnp.int32), None,
+                                           None, None)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        params, state, opt, loss, _ = step(params, state, opt, x, y,
+                                           jnp.asarray(i, jnp.int32), None,
+                                           None, None)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = MEASURE_STEPS * BATCH / dt
+    print(json.dumps({
+        "metric": "LeNet-MNIST train throughput (batch=128, 1 chip: "
+                  f"{dev.device_kind})",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / REFERENCE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+__all__ = ["ParallelWrapper", "ParallelInference"]

@@ -1,0 +1,61 @@
+"""Multi-host distributed initialization.
+
+Parity surface: the reference's cluster story — Spark driver/executor setup
+(SparkDl4jMultiLayer) and the Aeron VoidParameterServer transport
+(SURVEY.md §5 'distributed communication backend'). TPU-native equivalent:
+``jax.distributed.initialize`` forms the multi-host runtime; after it, the
+SAME ParallelWrapper/pjit code runs unchanged — ``jax.devices()`` spans all
+hosts, the mesh covers the pod, and XLA routes collectives over ICI within a
+pod slice and DCN across slices. No parameter server, no gradient
+quantization, no custom transport.
+
+There is deliberately no Spark-equivalent job scheduler here: launching one
+process per host (GKE/JobSet, mpirun, etc.) replaces Spark executors, and
+fault tolerance is checkpoint/restart (util/model_serializer +
+orbax-compatible arrays) rather than task retry.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Initialize the multi-host JAX runtime (idempotent, env-var driven like
+    jax itself: COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID if args omitted).
+    Call once per host process before building meshes."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    kwargs = {}
+    if coordinator_address or os.environ.get("COORDINATOR_ADDRESS"):
+        kwargs["coordinator_address"] = (coordinator_address or
+                                         os.environ["COORDINATOR_ADDRESS"])
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        jax.distributed.initialize(**kwargs)
+
+
+def pod_mesh(axes=("data",), shape=None) -> Mesh:
+    """Mesh over every device on every host. shape: optional tuple matching
+    axes, e.g. axes=('data','model') shape=(4, 2)."""
+    devs = np.array(jax.devices())
+    if shape is not None:
+        devs = devs.reshape(shape)
+    return Mesh(devs, axes)
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This host's slice of a globally-sharded batch (data axis split across
+    processes, parity with each Spark executor reading its partition)."""
+    per = global_batch // jax.process_count()
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)

@@ -1,0 +1,249 @@
+"""Data-parallel training over a device mesh.
+
+Parity surface: reference ParallelWrapper (deeplearning4j-scaleout-
+parallelwrapper/.../ParallelWrapper.java:58 — N replicas, synchronous param
+averaging every ``averagingFrequency`` iterations :251-371, or async
+threshold-encoded gradient sharing via EncodedGradientsAccumulator) and the
+Spark ParameterAveragingTrainingMaster / SharedTrainingMaster stacks
+(SURVEY.md §2 #19/#22/#23).
+
+TPU-native design: there are no worker threads, no parameter server, no
+gradient quantization — one jit'd SPMD train step over a
+``jax.sharding.Mesh``:
+
+- params/opt-state: replicated (NamedSharding(P()))
+- batch: sharded along the mesh 'data' axis (P('data'))
+- XLA inserts the gradient all-reduce over ICI automatically from the
+  sharding annotations (the scaling-book recipe). This is mathematically the
+  reference's averaging with frequency=1 and supersedes its Aeron gradient-
+  sharing path (SURVEY.md §5 maps all three mechanisms to psum).
+
+``averaging_frequency > 1`` reproduces the reference's divergent-replica
+semantics: each device takes k independent local steps on its own params
+(shard_map + lax.scan over microbatches), then params AND updater state are
+pmean-averaged (parity: averageUpdatersState ParallelWrapper.java:339).
+
+Multi-host: the same code scales over DCN by initializing
+``jax.distributed`` (see deeplearning4j_tpu.parallel.distributed) — the mesh
+then spans all hosts' devices and the collectives ride ICI within a pod and
+DCN across pods. No NCCL/Aeron equivalent is needed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.7 moved it out of experimental
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+def default_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+class ParallelWrapper:
+    """Data-parallel trainer wrapping a MultiLayerNetwork or ComputationGraph.
+
+    Usage (parity: ParallelWrapper.Builder):
+        pw = ParallelWrapper(net, workers=8, averaging_frequency=1)
+        pw.fit(iterator)
+
+    workers = number of mesh devices (defaults to all).
+    averaging_frequency=1 → per-step gradient allreduce (recommended on TPU);
+    >1 → reference-style local steps + periodic param/updater averaging.
+    """
+
+    def __init__(self, model, workers: Optional[int] = None,
+                 averaging_frequency: int = 1, prefetch_buffer: int = 2,
+                 mesh: Optional[Mesh] = None):
+        self.model = model
+        self.mesh = mesh if mesh is not None else default_mesh(workers)
+        self.n_devices = self.mesh.devices.size
+        self.averaging_frequency = max(1, int(averaging_frequency))
+        self.prefetch_buffer = prefetch_buffer
+        self._step_fn = None
+
+    # ------------------------------------------------------------------ build
+    def _replicated(self, tree):
+        sharding = NamedSharding(self.mesh, P())
+        return jax.device_put(tree, sharding)
+
+    def _build_sync_step(self):
+        """averaging_frequency == 1: jit with sharding annotations; XLA emits
+        the ICI all-reduce in backward."""
+        model = self.model
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        data_sh = NamedSharding(mesh, P("data"))
+        transforms = model._transforms
+
+        def step(params, state, opt_state, x, y, it):
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(model.conf.global_conf.seed), it)
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                model._loss, has_aux=True)(params, state, x, y, rng, None, None)
+            grads = model._normalize_grads(grads)
+            new_params, new_opt = [], []
+            for i, (l, t) in enumerate(zip(model.layers, transforms)):
+                if not params[i]:
+                    new_params.append(params[i])
+                    new_opt.append(opt_state[i])
+                    continue
+                u, o = t.update(grads[i], opt_state[i], params[i])
+                p = optax.apply_updates(params[i], u)
+                new_params.append(l.apply_constraints(p))
+                new_opt.append(o)
+            return new_params, new_state, new_opt, loss
+
+        return jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, data_sh, data_sh, None),
+            out_shardings=(repl, repl, repl, repl),
+            donate_argnums=(0, 1, 2))
+
+    def _build_averaging_step(self):
+        """averaging_frequency == k > 1: each device scans k local updates on
+        its own divergent params, then params+opt state are pmean'd
+        (parity: ParallelWrapper averaging + averageUpdatersState)."""
+        model = self.model
+        mesh = self.mesh
+        transforms = model._transforms
+        k = self.averaging_frequency
+
+        def local_update(params, state, opt_state, x, y, rng):
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                model._loss, has_aux=True)(params, state, x, y, rng, None, None)
+            grads = model._normalize_grads(grads)
+            new_params, new_opt = [], []
+            for i, (l, t) in enumerate(zip(model.layers, transforms)):
+                if not params[i]:
+                    new_params.append(params[i])
+                    new_opt.append(opt_state[i])
+                    continue
+                u, o = t.update(grads[i], opt_state[i], params[i])
+                p = optax.apply_updates(params[i], u)
+                new_params.append(l.apply_constraints(p))
+                new_opt.append(o)
+            return new_params, new_state, new_opt, loss
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P(), P(None, "data"), P(None, "data"), P()),
+                 out_specs=(P(), P(), P(), P()),
+                 check_vma=False)
+        def step(params, state, opt_state, xs, ys, it):
+            # xs: (k, local_batch, ...) after the leading microbatch axis;
+            # batch axis is sharded over 'data'
+            def body(carry, inp):
+                params, state, opt_state, j = carry
+                x, y = inp
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(model.conf.global_conf.seed), it + j)
+                rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+                p, s, o, loss = local_update(params, state, opt_state, x, y, rng)
+                return (p, s, o, j + 1), loss
+
+            (params, state, opt_state, _), losses = jax.lax.scan(
+                body, (params, state, opt_state, 0), (xs, ys))
+            # average divergent replicas (params + updater state + bn stats)
+            params = jax.lax.pmean(params, "data")
+            state = jax.lax.pmean(state, "data")
+            opt_state = jax.lax.pmean(opt_state, "data")
+            return params, state, opt_state, jax.lax.pmean(losses.mean(), "data")
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, data, epochs=1):
+        """Train over the mesh. ``data``: iterator of DataSets (or list)."""
+        model = self.model
+        if model.params is None:
+            model.init()
+        model.params = self._replicated(model.params)
+        model.state = self._replicated(model.state)
+        model.opt_state = self._replicated(model.opt_state)
+
+        if self.averaging_frequency == 1:
+            if self._step_fn is None:
+                self._step_fn = self._build_sync_step()
+            for _ in range(epochs):
+                if hasattr(data, "reset"):
+                    data.reset()
+                for ds in data:
+                    if not isinstance(ds, DataSet):
+                        ds = DataSet(*ds)
+                    x, y = self._pad_to_devices(ds)
+                    model.params, model.state, model.opt_state, loss = \
+                        self._step_fn(model.params, model.state, model.opt_state,
+                                      x, y, jnp.asarray(model.iteration, jnp.int32))
+                    model._score = float(loss)
+                    model.iteration += 1
+                    for lst in model.listeners:
+                        lst.iteration_done(model, model.iteration, model.epoch)
+                model.epoch += 1
+        else:
+            if self._step_fn is None:
+                self._step_fn = self._build_averaging_step()
+            k = self.averaging_frequency
+            for _ in range(epochs):
+                if hasattr(data, "reset"):
+                    data.reset()
+                micro: List[DataSet] = []
+                for ds in data:
+                    if not isinstance(ds, DataSet):
+                        ds = DataSet(*ds)
+                    micro.append(ds)
+                    if len(micro) == k:
+                        self._fit_avg_chunk(micro)
+                        micro = []
+                if micro:
+                    self._fit_avg_chunk(micro)
+                model.epoch += 1
+        return model
+
+    def _fit_avg_chunk(self, micro: List[DataSet]):
+        model = self.model
+        # microbatches may differ in size (last batch of an epoch): pad each
+        # to the chunk max by wrapping, then to a device multiple
+        max_b = max(d.features.shape[0] for d in micro)
+
+        def pad_to(arr, b):
+            while arr.shape[0] < b:
+                arr = np.concatenate([arr, arr[:b - arr.shape[0]]])
+            return self._pad_batch(arr)
+
+        xs = jnp.stack([jnp.asarray(pad_to(d.features, max_b)) for d in micro])
+        ys = jnp.stack([jnp.asarray(pad_to(d.labels, max_b)) for d in micro])
+        model.params, model.state, model.opt_state, loss = self._step_fn(
+            model.params, model.state, model.opt_state, xs, ys,
+            jnp.asarray(model.iteration, jnp.int32))
+        model._score = float(loss)
+        model.iteration += len(micro)
+        for lst in model.listeners:
+            lst.iteration_done(model, model.iteration, model.epoch)
+
+    def _pad_batch(self, arr):
+        n = self.n_devices
+        b = arr.shape[0]
+        if b % n == 0:
+            return arr
+        pad = n - (b % n)
+        reps = np.concatenate([arr, arr[:pad]])
+        return reps
+
+    def _pad_to_devices(self, ds: DataSet):
+        return (jnp.asarray(self._pad_batch(ds.features)),
+                jnp.asarray(self._pad_batch(ds.labels)))

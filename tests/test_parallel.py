@@ -1,0 +1,86 @@
+"""Data-parallel training tests on the 8-device virtual CPU mesh
+(parity role: ParallelWrapperTest / Spark local[N] tests, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Sgd, Adam
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.parallel import ParallelWrapper, ParallelInference
+
+
+def _net(seed=5, lr=0.05):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Sgd(lr))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=160, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(x.sum(axis=1) * 2).astype(int) % 3]
+    return DataSet(x, y)
+
+
+def test_sync_dp_matches_single_device():
+    """Gradient-allreduce DP on 8 devices must equal single-device training on
+    the same global batch (the reference's averaging-freq-1 semantics)."""
+    ds = _data()
+    single = _net()
+    for batch in ds.batch_by(32):
+        single.fit(batch)
+
+    dp_net = _net()
+    pw = ParallelWrapper(dp_net, workers=8, averaging_frequency=1)
+    pw.fit(ListDataSetIterator(_data(), 32))
+
+    w1 = np.asarray(single.params[0]["W"])
+    w2 = np.asarray(dp_net.params[0]["W"])
+    assert np.allclose(w1, w2, atol=1e-5), np.abs(w1 - w2).max()
+
+
+def test_averaging_mode_trains():
+    ds = _data()
+    net = _net(lr=0.1)
+    pw = ParallelWrapper(net, workers=8, averaging_frequency=4)
+    s0 = net.score(ds)
+    for _ in range(6):
+        pw.fit(ListDataSetIterator(_data(), 64))
+    assert net.score(ds) < s0
+
+
+def test_parallel_inference_matches_model_output():
+    net = _net()
+    ds = _data(40)
+    pi = ParallelInference(net)
+    out = pi.output(ds.features)
+    ref = np.asarray(net.output(ds.features))
+    assert out.shape == ref.shape
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_parallel_inference_batching_async():
+    net = _net()
+    pi = ParallelInference(net, batch_timeout_ms=5.0).start()
+    futs = [pi.submit(np.random.rand(3, 4).astype(np.float32))
+            for _ in range(7)]
+    outs = [f.result(timeout=30) for f in futs]
+    pi.shutdown()
+    assert all(o.shape == (3, 3) for o in outs)
+
+
+def test_uneven_batch_padding():
+    net = _net()
+    pw = ParallelWrapper(net, workers=8)
+    pw.fit(ListDataSetIterator(_data(n=30), 30))  # 30 % 8 != 0
+    assert np.isfinite(net.get_score())
