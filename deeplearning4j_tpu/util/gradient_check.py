@@ -14,6 +14,13 @@ from __future__ import annotations
 
 import numpy as np
 import jax
+
+# x64 MUST be on before any array in the checked function is created —
+# central differences at eps~1e-6 cancel catastrophically in float32. This is
+# a test-time utility; importing it opts the process into x64 (the reference
+# similarly forces DataBuffer.Type.DOUBLE in its gradient-check suites).
+jax.config.update("jax_enable_x64", True)
+
 import jax.numpy as jnp
 
 
@@ -25,11 +32,8 @@ def gradient_check_fn(loss_fn, params, eps=1e-6, max_rel_error=1e-3,
     loss_fn: params_pytree -> scalar. Must be pure.
     Returns (n_failures, n_checked, max_rel_err_seen).
     """
-    if not jax.config.jax_enable_x64:
-        # finite differences at eps~1e-6 drown in float32 rounding; x64 is
-        # mandatory for meaningful checks (reference forces DOUBLE likewise)
-        jax.config.update("jax_enable_x64", True)
-    grads = jax.grad(loss_fn)(params)
+    loss_fn = jax.jit(loss_fn)  # compile once; FD loop then runs fast
+    grads = jax.jit(jax.grad(loss_fn))(params)
     leaves, treedef = jax.tree_util.tree_flatten(params)
     gleaves = jax.tree_util.tree_flatten(grads)[0]
     rng = np.random.RandomState(seed)
@@ -38,7 +42,7 @@ def gradient_check_fn(loss_fn, params, eps=1e-6, max_rel_error=1e-3,
     checked = 0
     worst = 0.0
     for li, (leaf, gleaf) in enumerate(zip(leaves, gleaves)):
-        arr = np.asarray(leaf, np.float64)
+        arr = np.array(leaf, np.float64)  # copy: jax buffers are read-only
         ganalytic = np.asarray(gleaf, np.float64)
         n = arr.size
         idxs = (np.arange(n) if n <= max_checks_per_array

@@ -1,0 +1,237 @@
+"""Numeric gradient checks — the correctness backbone.
+
+Parity role: reference gradientcheck/ suites (CNNGradientCheckTest,
+LSTMGradientCheckTests, BNGradientCheckTest, VaeGradientCheckTests,
+LossFunctionGradientCheck, GradientCheckTestsMasking — SURVEY.md §4).
+Analytic jax.grad vs central finite differences in float64.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, OutputLayer, ConvolutionLayer, SubsamplingLayer,
+    BatchNormalization, LSTM, GravesLSTM, SimpleRnn, RnnOutputLayer,
+    EmbeddingLayer, GlobalPoolingLayer, Bidirectional, AutoEncoder,
+    VariationalAutoencoder, LossLayer,
+)
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.util.gradient_check import gradient_check_network
+
+
+def _check(conf, x, y, max_checks=12, tol=1e-3):
+    net = MultiLayerNetwork(conf).init(jax.random.PRNGKey(7))
+    fails, checked, worst = gradient_check_network(
+        net, np.asarray(x), np.asarray(y), max_checks_per_array=max_checks,
+        max_rel_error=tol)
+    assert fails == 0, f"{fails}/{checked} gradient checks failed (worst rel {worst:.2e})"
+    assert checked > 0
+
+
+def _builder(act="tanh"):
+    return (NeuralNetConfiguration.builder().seed(12).updater(Sgd(0.1))
+            .activation(act).weight_init("xavier"))
+
+
+def test_dense_mlp_gradients():
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 4)
+    y = np.eye(3)[rng.randint(0, 3, 5)]
+    conf = (_builder().list()
+            .layer(DenseLayer(n_out=6))
+            .layer(DenseLayer(n_out=5, activation="sigmoid"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    _check(conf, x, y)
+
+
+def test_dense_l1_l2_gradients():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 4)
+    y = np.eye(3)[rng.randint(0, 3, 4)]
+    conf = (_builder().l1(0.01).l2(0.02).list()
+            .layer(DenseLayer(n_out=6))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    _check(conf, x, y)
+
+
+@pytest.mark.parametrize("loss,out_act,ydist", [
+    ("mse", "identity", "real"),
+    ("l1", "identity", "real"),
+    ("xent", "sigmoid", "binary"),
+    ("mcxent", "softmax", "onehot"),
+    ("hinge", "identity", "pm1"),
+    ("poisson", "softplus", "count"),
+    ("kl_divergence", "softmax", "simplex"),
+])
+def test_loss_function_gradients(loss, out_act, ydist):
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 3)
+    if ydist == "real":
+        y = rng.randn(4, 2)
+    elif ydist == "binary":
+        y = rng.randint(0, 2, (4, 2)).astype(float)
+    elif ydist == "onehot":
+        y = np.eye(2)[rng.randint(0, 2, 4)]
+    elif ydist == "pm1":
+        y = rng.choice([-1.0, 1.0], (4, 2))
+    elif ydist == "count":
+        y = rng.randint(0, 5, (4, 2)).astype(float)
+    else:
+        y = rng.dirichlet(np.ones(2), 4)
+    conf = (_builder().list()
+            .layer(DenseLayer(n_out=5))
+            .layer(OutputLayer(n_out=2, activation=out_act, loss=loss))
+            .set_input_type(InputType.feed_forward(3)).build())
+    _check(conf, x, y)
+
+
+def test_cnn_gradients():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 8, 8, 2)
+    y = np.eye(3)[rng.randint(0, 3, 3)]
+    conf = (_builder("relu").list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=3, activation="tanh"))
+            .layer(SubsamplingLayer(pooling_type="avg", kernel_size=2, stride=2))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 2)).build())
+    _check(conf, x, y)
+
+
+def test_batchnorm_gradients():
+    # BN in train mode uses batch stats; check grads through them
+    rng = np.random.RandomState(5)
+    x = rng.randn(6, 4)
+    y = np.eye(2)[rng.randint(0, 2, 6)]
+    conf = (_builder().list()
+            .layer(DenseLayer(n_out=5))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    _check(conf, x, y)
+
+
+def test_lstm_gradients():
+    rng = np.random.RandomState(6)
+    x = rng.randn(3, 5, 4)
+    y = np.eye(2)[rng.randint(0, 2, (3, 5))]
+    conf = (_builder().list()
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4)).build())
+    _check(conf, x, y)
+
+
+def test_graves_lstm_gradients():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 4, 3)
+    y = np.eye(2)[rng.randint(0, 2, (2, 4))]
+    conf = (_builder().list()
+            .layer(GravesLSTM(n_out=5))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    _check(conf, x, y)
+
+
+def test_bidirectional_gradients():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 4, 3)
+    y = np.eye(2)[rng.randint(0, 2, (2, 4))]
+    conf = (_builder().list()
+            .layer(Bidirectional(fwd=LSTM(n_out=4), mode="concat"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    _check(conf, x, y)
+
+
+def test_simple_rnn_global_pooling_gradients():
+    rng = np.random.RandomState(9)
+    x = rng.randn(3, 5, 4)
+    y = np.eye(3)[rng.randint(0, 3, 3)]
+    conf = (_builder().list()
+            .layer(SimpleRnn(n_out=5))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4)).build())
+    _check(conf, x, y)
+
+
+def test_masking_gradients():
+    """RNN loss with a labels mask (parity: GradientCheckTestsMasking)."""
+    rng = np.random.RandomState(10)
+    x = rng.randn(3, 5, 4)
+    y = np.eye(2)[rng.randint(0, 2, (3, 5))]
+    mask = np.ones((3, 5))
+    mask[0, 3:] = 0
+    mask[2, 1:] = 0
+    net = MultiLayerNetwork((_builder().list()
+            .layer(LSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4)).build())).init(jax.random.PRNGKey(3))
+    import jax.numpy as jnp
+    params64 = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64),
+                                      net.params)
+
+    def loss_fn(params):
+        loss, _ = net._loss(params, net.state, jnp.asarray(x), jnp.asarray(y),
+                            None, jnp.asarray(mask), jnp.asarray(mask))
+        return loss
+
+    from deeplearning4j_tpu.util.gradient_check import gradient_check_fn
+    fails, checked, worst = gradient_check_fn(loss_fn, params64,
+                                              max_checks_per_array=10)
+    assert fails == 0, f"{fails}/{checked} failed (worst {worst:.2e})"
+
+
+def test_vae_gradients():
+    """VAE -ELBO gradients without sampling noise (deterministic eps=0 path —
+    parity: VaeGradientCheckTests uses fixed seeds similarly)."""
+    rng = np.random.RandomState(11)
+    x = (rng.rand(4, 6) > 0.5).astype(float)
+    vae = VariationalAutoencoder(n_in=6, n_out=3, encoder_layer_sizes=(8,),
+                                 decoder_layer_sizes=(8,), activation="tanh",
+                                 weight_init="xavier")
+    params = vae.init(jax.random.PRNGKey(0), dtype=np.float64)
+    import jax.numpy as jnp
+    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64), params)
+
+    def loss_fn(p):
+        return vae.compute_score(p, jnp.asarray(x), train=False, rng=None)
+
+    from deeplearning4j_tpu.util.gradient_check import gradient_check_fn
+    fails, checked, worst = gradient_check_fn(loss_fn, params,
+                                              max_checks_per_array=8)
+    assert fails == 0, f"{fails}/{checked} failed (worst {worst:.2e})"
+
+
+def test_autoencoder_gradients():
+    rng = np.random.RandomState(12)
+    x = rng.rand(4, 5)
+    ae = AutoEncoder(n_in=5, n_out=3, activation="sigmoid",
+                     weight_init="xavier", corruption_level=0.0)
+    params = ae.init(jax.random.PRNGKey(1))
+    import jax.numpy as jnp
+    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64), params)
+
+    def loss_fn(p):
+        return ae.compute_score(p, jnp.asarray(x), train=False, rng=None)
+
+    from deeplearning4j_tpu.util.gradient_check import gradient_check_fn
+    fails, checked, worst = gradient_check_fn(loss_fn, params,
+                                              max_checks_per_array=10)
+    assert fails == 0, f"{fails}/{checked} failed (worst {worst:.2e})"
+
+
+def test_embedding_gradients():
+    rng = np.random.RandomState(13)
+    x = rng.randint(0, 7, (6, 1)).astype(np.float64)
+    y = np.eye(3)[rng.randint(0, 3, 6)]
+    conf = (_builder().list()
+            .layer(EmbeddingLayer(n_in=7, n_out=4))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(7)).build())
+    _check(conf, x, y)
