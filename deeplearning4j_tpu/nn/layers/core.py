@@ -52,7 +52,7 @@ class DenseLayer(Layer):
 
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
-        if x.ndim > 2 and x.shape[-1] != self.n_in:
+        if x.ndim >= 4 or (x.ndim == 3 and x.shape[-1] != self.n_in):
             x = x.reshape(x.shape[0], -1)  # implicit CNN→FF flatten
         y = x @ params["W"]
         if self.has_bias:
@@ -69,7 +69,7 @@ class OutputLayer(DenseLayer):
 
     def compute_score(self, params, x, labels, mask=None, *, train=False, rng=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
-        if x.ndim > 2 and x.shape[-1] != self.n_in:
+        if x.ndim >= 4 or (x.ndim == 3 and x.shape[-1] != self.n_in):
             x = x.reshape(x.shape[0], -1)
         pre = x @ params["W"]
         if self.has_bias:

@@ -1,0 +1,82 @@
+"""ResNet50 as a ComputationGraph.
+
+Parity surface: reference zoo/model/ResNet50.java:1-239 (bottleneck residual
+blocks with identity/projection shortcuts, ElementWiseVertex add). NHWC
+layout; BN after each conv (no bias on convs feeding BN — saves HBM traffic,
+XLA fuses BN+relu into the conv epilogue).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer, SubsamplingLayer, BatchNormalization, ActivationLayer,
+    GlobalPoolingLayer, OutputLayer, ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.updaters import Nesterovs
+from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+
+
+class ResNet50(ZooModel):
+    name = "resnet50"
+    default_input_shape = (224, 224, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-1, momentum=0.9))
+             .weight_init("relu")
+             .l2(1e-4)
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, k, stride=1, pad=0, act=True):
+            g.add_layer(f"{name}_conv",
+                        ConvolutionLayer(n_out=n_out, kernel_size=k,
+                                         stride=stride, padding=pad,
+                                         has_bias=False), inp)
+            g.add_layer(f"{name}_bn",
+                        BatchNormalization(
+                            activation="relu" if act else "identity"),
+                        f"{name}_conv")
+            return f"{name}_bn"
+
+        def bottleneck(name, inp, filters, stride=1, project=False):
+            f1, f2, f3 = filters
+            x = conv_bn(f"{name}_a", inp, f1, 1, stride=stride)
+            x = conv_bn(f"{name}_b", x, f2, 3, pad=1)
+            x = conv_bn(f"{name}_c", x, f3, 1, act=False)
+            if project:
+                sc = conv_bn(f"{name}_sc", inp, f3, 1, stride=stride, act=False)
+            else:
+                sc = inp
+            g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, sc)
+            g.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                        f"{name}_add")
+            return f"{name}_out"
+
+        x = conv_bn("stem", "input", 64, 7, stride=2, pad=3)
+        g.add_layer("stem_pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=3,
+                                     stride=2, padding=1), x)
+        x = "stem_pool"
+        stages = [
+            ("res2", (64, 64, 256), 3, 1),
+            ("res3", (128, 128, 512), 4, 2),
+            ("res4", (256, 256, 1024), 6, 2),
+            ("res5", (512, 512, 2048), 3, 2),
+        ]
+        for sname, filters, blocks, stride in stages:
+            x = bottleneck(f"{sname}_0", x, filters, stride=stride, project=True)
+            for i in range(1, blocks):
+                x = bottleneck(f"{sname}_{i}", x, filters)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("fc", OutputLayer(n_out=self.num_classes,
+                                      activation="softmax", loss="mcxent",
+                                      n_in=2048), "avgpool")
+        g.set_outputs("fc")
+        return g.build()

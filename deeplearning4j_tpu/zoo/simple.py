@@ -1,0 +1,215 @@
+"""Sequential zoo models.
+
+Parity surface (architectures match the reference definitions; layout is
+NHWC-native):
+- LeNet            — zoo/model/LeNet.java:1-127
+- SimpleCNN        — zoo/model/SimpleCNN.java
+- AlexNet          — zoo/model/AlexNet.java (LRN + 5 conv + 3 dense)
+- VGG16 / VGG19    — zoo/model/VGG16.java:1-181, VGG19.java
+- Darknet19        — zoo/model/Darknet19.java (conv-BN-leakyrelu stacks)
+- TextGenerationLSTM — zoo/model/TextGenerationLSTM.java (char-level 2xLSTM)
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer, SubsamplingLayer, DenseLayer, OutputLayer,
+    BatchNormalization, LocalResponseNormalization, DropoutLayer,
+    GlobalPoolingLayer, LSTM, RnnOutputLayer, ActivationLayer,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+
+
+class LeNet(ZooModel):
+    name = "lenet"
+    default_input_shape = (28, 28, 1)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(Adam(1e-3))
+                .weight_init("xavier")
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=5, stride=1,
+                                        activation="relu"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=2,
+                                        stride=2))
+                .layer(ConvolutionLayer(n_out=50, kernel_size=5, stride=1,
+                                        activation="relu"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=2,
+                                        stride=2))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class SimpleCNN(ZooModel):
+    name = "simplecnn"
+    default_input_shape = (48, 48, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .activation("relu")
+             .weight_init("relu")
+             .list())
+        for n_out, pool in [(16, False), (16, True), (32, False), (32, True),
+                            (64, False), (64, True)]:
+            b.layer(ConvolutionLayer(n_out=n_out, kernel_size=3, padding=1))
+            b.layer(BatchNormalization())
+            if pool:
+                b.layer(SubsamplingLayer(pooling_type="max", kernel_size=2,
+                                         stride=2))
+        b.layer(DropoutLayer(dropout=0.5))
+        b.layer(GlobalPoolingLayer(pooling_type="avg"))
+        b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                            loss="mcxent"))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+class AlexNet(ZooModel):
+    name = "alexnet"
+    default_input_shape = (224, 224, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(Nesterovs(1e-2, momentum=0.9))
+                .weight_init("distribution").dist("normal", 0.0, 0.01)
+                .activation("relu")
+                .l2(5e-4)
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel_size=11, stride=4,
+                                        padding=2))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=3,
+                                        stride=2))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=5, padding=2,
+                                        bias_init=1.0))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=3,
+                                        stride=2))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=3, padding=1))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=3, padding=1,
+                                        bias_init=1.0))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=3, padding=1,
+                                        bias_init=1.0))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=3,
+                                        stride=2))
+                .layer(DenseLayer(n_out=4096, bias_init=1.0, dropout=0.5))
+                .layer(DenseLayer(n_out=4096, bias_init=1.0, dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+def _vgg_blocks(b, cfg):
+    for item in cfg:
+        if item == "M":
+            b.layer(SubsamplingLayer(pooling_type="max", kernel_size=2, stride=2))
+        else:
+            b.layer(ConvolutionLayer(n_out=item, kernel_size=3, padding=1,
+                                     activation="relu"))
+    return b
+
+
+class VGG16(ZooModel):
+    name = "vgg16"
+    default_input_shape = (224, 224, 3)
+    _cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+            512, 512, 512, "M", 512, 512, 512, "M"]
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-2, momentum=0.9))
+             .weight_init("relu")
+             .list())
+        _vgg_blocks(b, self._cfg)
+        b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                            loss="mcxent"))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+class VGG19(VGG16):
+    name = "vgg19"
+    _cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+            512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
+
+
+class Darknet19(ZooModel):
+    name = "darknet19"
+    default_input_shape = (224, 224, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .weight_init("relu")
+             .list())
+
+        def conv_bn(n_out, k):
+            b.layer(ConvolutionLayer(n_out=n_out, kernel_size=k,
+                                     padding=k // 2, has_bias=False))
+            b.layer(BatchNormalization(activation="leakyrelu"))
+
+        conv_bn(32, 3)
+        b.layer(SubsamplingLayer(pooling_type="max", kernel_size=2, stride=2))
+        conv_bn(64, 3)
+        b.layer(SubsamplingLayer(pooling_type="max", kernel_size=2, stride=2))
+        for ns in [(128, 64, 128), (256, 128, 256)]:
+            conv_bn(ns[0], 3)
+            conv_bn(ns[1], 1)
+            conv_bn(ns[2], 3)
+            b.layer(SubsamplingLayer(pooling_type="max", kernel_size=2, stride=2))
+        for ns in [(512, 256, 512, 256, 512), (1024, 512, 1024, 512, 1024)]:
+            for i, n in enumerate(ns):
+                conv_bn(n, 3 if i % 2 == 0 else 1)
+            if ns[0] == 512:
+                b.layer(SubsamplingLayer(pooling_type="max", kernel_size=2,
+                                         stride=2))
+        b.layer(ConvolutionLayer(n_out=self.num_classes, kernel_size=1))
+        b.layer(GlobalPoolingLayer(pooling_type="avg"))
+        b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                            loss="mcxent", has_bias=True,
+                            n_in=self.num_classes))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+class TextGenerationLSTM(ZooModel):
+    name = "textgenlstm"
+    default_input_shape = (77,)  # vocab size
+
+    def __init__(self, total_unique_characters: int = 77, seed: int = 123,
+                 **kwargs):
+        super().__init__(num_classes=total_unique_characters, seed=seed,
+                         input_shape=(total_unique_characters,), **kwargs)
+
+    def conf(self):
+        vocab = self.input_shape[0]
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(Adam(1e-3))
+                .weight_init("xavier")
+                .gradient_normalization("ClipElementWiseAbsoluteValue", 10.0)
+                .list()
+                .layer(LSTM(n_out=256, activation="tanh"))
+                .layer(LSTM(n_out=256, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(vocab))
+                .build())

@@ -1,0 +1,54 @@
+"""ZooModel base.
+
+Parity surface: reference zoo/ZooModel.java — ``init()`` builds the network,
+``initPretrained()`` loads pretrained weights. This environment has zero
+network egress, so pretrained weights load from the local cache dir
+(``<data_dir>/pretrained/<name>.zip`` — same role as the reference's
+~/.deeplearning4j cache + checksum) and raise a clear error when absent.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Tuple
+
+
+class ZooModel:
+    name: str = "zoo_model"
+    default_input_shape: Tuple[int, ...] = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, ...] = None, **kwargs):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = tuple(input_shape or self.default_input_shape)
+        self.kwargs = kwargs
+
+    def conf(self):
+        """Build the MultiLayerConfiguration / ComputationGraphConfiguration."""
+        raise NotImplementedError
+
+    def init(self):
+        """Build + initialize the network (parity: ZooModel.init)."""
+        conf = self.conf()
+        from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+        from deeplearning4j_tpu.models import MultiLayerNetwork, ComputationGraph
+        if isinstance(conf, MultiLayerConfiguration):
+            return MultiLayerNetwork(conf).init()
+        return ComputationGraph(conf).init()
+
+    def pretrained_path(self) -> Path:
+        from deeplearning4j_tpu.data.fetchers import data_dir
+        return data_dir() / "pretrained" / f"{self.name}.zip"
+
+    def init_pretrained(self):
+        """Load pretrained weights from the local cache
+        (parity: ZooModel.initPretrained :40)."""
+        p = self.pretrained_path()
+        if not p.exists():
+            raise FileNotFoundError(
+                f"No pretrained weights for '{self.name}' at {p}. This "
+                f"environment has no network egress; place a model zip there "
+                f"(util.model_serializer format) to use init_pretrained().")
+        from deeplearning4j_tpu.util.model_serializer import guess_model
+        return guess_model(str(p))

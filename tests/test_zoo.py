@@ -1,0 +1,79 @@
+"""Zoo model instantiation + forward tests
+(parity role: deeplearning4j-zoo TestInstantiation, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (
+    LeNet, SimpleCNN, AlexNet, VGG16, VGG19, Darknet19, TextGenerationLSTM,
+    ResNet50, GoogLeNet, InceptionResNetV1, FaceNetNN4Small2,
+)
+
+
+def _fwd_check(model, shape, n_classes):
+    net = model.init()
+    x = np.random.RandomState(0).rand(2, *shape).astype(np.float32)
+    out = net.output(x)
+    if isinstance(out, list):
+        out = out[0]
+    assert out.shape == (2, n_classes)
+    assert np.all(np.isfinite(np.asarray(out)))
+    return net
+
+
+def test_lenet():
+    net = _fwd_check(LeNet(num_classes=10), (28, 28, 1), 10)
+    y = np.eye(10, dtype=np.float32)[np.random.randint(0, 10, 2)]
+    x = np.random.rand(2, 28, 28, 1).astype(np.float32)
+    net.fit(x, y)
+    assert np.isfinite(net.get_score())
+
+
+def test_simplecnn():
+    _fwd_check(SimpleCNN(num_classes=5, input_shape=(48, 48, 3)), (48, 48, 3), 5)
+
+
+def test_alexnet_small():
+    # 224 is the reference default; use it (one forward, batch 2)
+    _fwd_check(AlexNet(num_classes=7), (224, 224, 3), 7)
+
+
+def test_vgg16_small_input():
+    _fwd_check(VGG16(num_classes=10, input_shape=(32, 32, 3)), (32, 32, 3), 10)
+
+
+def test_vgg19_constructs():
+    conf = VGG19(num_classes=10, input_shape=(32, 32, 3)).conf()
+    assert len(conf.layers) == 24  # 16 conv + 5 pool + 3 dense/out
+
+
+def test_darknet19_small():
+    _fwd_check(Darknet19(num_classes=10, input_shape=(64, 64, 3)), (64, 64, 3), 10)
+
+
+def test_textgen_lstm():
+    m = TextGenerationLSTM(total_unique_characters=30)
+    net = m.init()
+    x = np.random.rand(2, 6, 30).astype(np.float32)
+    out = net.output(x)
+    assert out.shape == (2, 6, 30)
+
+
+def test_resnet50():
+    net = _fwd_check(ResNet50(num_classes=10, input_shape=(64, 64, 3)),
+                     (64, 64, 3), 10)
+    assert net.num_params() > 23_000_000  # ~23.6M + fc
+
+
+def test_googlenet():
+    _fwd_check(GoogLeNet(num_classes=10, input_shape=(64, 64, 3)), (64, 64, 3), 10)
+
+
+@pytest.mark.slow
+def test_inception_resnet_v1():
+    _fwd_check(InceptionResNetV1(num_classes=10, input_shape=(96, 96, 3)),
+               (96, 96, 3), 10)
+
+
+def test_facenet():
+    _fwd_check(FaceNetNN4Small2(num_classes=10), (96, 96, 3), 10)
