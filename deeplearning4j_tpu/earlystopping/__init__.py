@@ -1,0 +1,18 @@
+from deeplearning4j_tpu.earlystopping.early_stopping import (
+    EarlyStoppingConfiguration, EarlyStoppingResult, EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition, MaxTimeTerminationCondition,
+    ScoreImprovementEpochTerminationCondition, MaxScoreIterationTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    DataSetLossCalculator, ClassificationScoreCalculator,
+    LocalFileModelSaver, InMemoryModelSaver,
+)
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult", "EarlyStoppingTrainer",
+    "MaxEpochsTerminationCondition", "MaxTimeTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+    "DataSetLossCalculator", "ClassificationScoreCalculator",
+    "LocalFileModelSaver", "InMemoryModelSaver",
+]

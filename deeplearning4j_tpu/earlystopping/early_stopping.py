@@ -1,0 +1,274 @@
+"""Early stopping.
+
+Parity surface: reference earlystopping/ — EarlyStoppingConfiguration
+(builder), epoch + iteration termination conditions, score calculators,
+model savers (LocalFileModelSaver/InMemoryModelSaver), and
+BaseEarlyStoppingTrainer.fit (trainer/BaseEarlyStoppingTrainer.java:76:
+per-epoch train → score → track best → save → check conditions →
+EarlyStoppingResult).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Optional, List, Any
+
+
+# ------------------------------------------------------- termination conditions
+
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, last_score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs with no (sufficient) improvement."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement=0.0):
+        self.max_no_improve = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self._best = math.inf
+        self._since = 0
+
+    def initialize(self):
+        self._best = math.inf
+        self._since = 0
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._since = 0
+        else:
+            self._since += 1
+        return self._since >= self.max_no_improve
+
+
+class MaxTimeTerminationCondition(IterationTerminationCondition,
+                                  EpochTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = None
+
+    def initialize(self):
+        self._start = time.perf_counter()
+
+    def terminate(self, *args):
+        if self._start is None:
+            self._start = time.perf_counter()
+        return (time.perf_counter() - self._start) > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort if score exceeds a bound (divergence guard)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, last_score):
+        return last_score > self.max_score
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    def terminate(self, last_score):
+        return math.isnan(last_score) or math.isinf(last_score)
+
+
+# ------------------------------------------------------------ score calculators
+
+class DataSetLossCalculator:
+    """Average model loss over a dataset iterator
+    (parity: scorecalc/DataSetLossCalculator)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        for ds in self.iterator:
+            total += model.score(ds) * ds.num_examples()
+            n += ds.num_examples()
+        return total / n if (self.average and n) else total
+
+
+class ClassificationScoreCalculator:
+    """1 - accuracy (so lower is better, matching the loss convention)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculate_score(self, model) -> float:
+        ev = model.evaluate(self.iterator)
+        return 1.0 - ev.accuracy()
+
+
+# -------------------------------------------------------------------- savers
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score):
+        import io
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        buf = io.BytesIO()
+        write_model(model, buf)
+        self._best = buf.getvalue()
+
+    def save_latest_model(self, model, score):
+        import io
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        buf = io.BytesIO()
+        write_model(model, buf)
+        self._latest = buf.getvalue()
+
+    def get_best_model(self):
+        import io
+        from deeplearning4j_tpu.util.model_serializer import guess_model
+        return None if self._best is None else guess_model(io.BytesIO(self._best))
+
+    def get_latest_model(self):
+        import io
+        from deeplearning4j_tpu.util.model_serializer import guess_model
+        return None if self._latest is None else guess_model(io.BytesIO(self._latest))
+
+
+class LocalFileModelSaver:
+    def __init__(self, directory: str):
+        import pathlib
+        self.dir = pathlib.Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def save_best_model(self, model, score):
+        model.save(str(self.dir / "bestModel.zip"))
+
+    def save_latest_model(self, model, score):
+        model.save(str(self.dir / "latestModel.zip"))
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.util.model_serializer import guess_model
+        p = self.dir / "bestModel.zip"
+        return guess_model(str(p)) if p.exists() else None
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.util.model_serializer import guess_model
+        p = self.dir / "latestModel.zip"
+        return guess_model(str(p)) if p.exists() else None
+
+
+# ------------------------------------------------------------- config + result
+
+@dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: Any = None
+    epoch_termination_conditions: List[EpochTerminationCondition] = field(
+        default_factory=list)
+    iteration_termination_conditions: List[IterationTerminationCondition] = \
+        field(default_factory=list)
+    model_saver: Any = None
+    save_last_model: bool = False
+    evaluate_every_n_epochs: int = 1
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str = ""
+    termination_details: str = ""
+    score_vs_epoch: dict = field(default_factory=dict)
+    best_model_epoch: int = -1
+    best_model_score: float = math.inf
+    total_epochs: int = 0
+    best_model: Any = None
+
+
+class EarlyStoppingTrainer:
+    """Parity: trainer/BaseEarlyStoppingTrainer.java:76 fit loop."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_data):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        result = EarlyStoppingResult()
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+
+        epoch = 0
+        while True:
+            if hasattr(self.train_data, "reset"):
+                self.train_data.reset()
+            aborted = False
+            for batch in self.train_data:
+                self.model._fit_batch(batch if not isinstance(batch, tuple)
+                                      else None or batch)
+                last = self.model.get_score()
+                for c in cfg.iteration_termination_conditions:
+                    if c.terminate(last):
+                        result.termination_reason = "IterationTerminationCondition"
+                        result.termination_details = type(c).__name__
+                        aborted = True
+                        break
+                if aborted:
+                    break
+            if aborted:
+                break
+
+            if cfg.score_calculator is not None and \
+                    epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.model)
+                result.score_vs_epoch[epoch] = score
+                if score < result.best_model_score:
+                    result.best_model_score = score
+                    result.best_model_epoch = epoch
+                    if cfg.model_saver is not None:
+                        cfg.model_saver.save_best_model(self.model, score)
+                if cfg.save_last_model and cfg.model_saver is not None:
+                    cfg.model_saver.save_latest_model(self.model, score)
+            else:
+                score = self.model.get_score()
+
+            stop = False
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, score):
+                    result.termination_reason = "EpochTerminationCondition"
+                    result.termination_details = type(c).__name__
+                    stop = True
+                    break
+            epoch += 1
+            if stop:
+                break
+
+        result.total_epochs = epoch
+        if cfg.model_saver is not None:
+            result.best_model = cfg.model_saver.get_best_model()
+        if result.best_model is None:
+            result.best_model = self.model
+        return result

@@ -1,0 +1,9 @@
+from deeplearning4j_tpu.optimize.listeners import (
+    IterationListener, ScoreIterationListener, PerformanceListener,
+    EvaluativeListener, CollectScoresIterationListener, CheckpointListener,
+    TimeIterationListener,
+)
+
+__all__ = ["IterationListener", "ScoreIterationListener", "PerformanceListener",
+           "EvaluativeListener", "CollectScoresIterationListener",
+           "CheckpointListener", "TimeIterationListener"]

@@ -1,0 +1,159 @@
+"""Training listeners.
+
+Parity surface: reference optimize/listeners/ — ScoreIterationListener,
+PerformanceListener (samples/sec, batches/sec, ETL time), EvaluativeListener,
+CollectScoresIterationListener, CheckpointListener, TimeIterationListener —
+hooked per iteration from the fit loop (StochasticGradientDescent.java:91).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional, List
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    """Listener SPI (parity: optimize/api/IterationListener)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (parity: ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, model.get_score())
+            print(f"Score at iteration {iteration} is {model.get_score()}")
+
+
+class PerformanceListener(IterationListener):
+    """Throughput reporting (parity: PerformanceListener — samples/sec,
+    batches/sec; ETL time here is host wait before device dispatch)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_batch = report_batch
+        self._last_time = None
+        self._last_iter = None
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            if dt > 0 and iters > 0:
+                batch_sec = iters / dt
+                msg = (f"iteration {iteration}: {batch_sec:.1f} batches/sec, "
+                       f"score {model.get_score():.5f}")
+                fit_t = getattr(model, "_last_fit_time", None)
+                if fit_t:
+                    msg += f", last step {fit_t * 1e3:.1f} ms"
+                log.info(msg)
+                print(msg)
+            self._last_time = now
+            self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Accumulate (iteration, score) pairs (parity: CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.get_score()))
+
+
+class EvaluativeListener(IterationListener):
+    """Periodic evaluation on a held-out set (parity: EvaluativeListener)."""
+
+    def __init__(self, test_data, frequency: int = 100,
+                 invocation: str = "iteration"):
+        self.test_data = test_data
+        self.frequency = max(1, frequency)
+        self.invocation = invocation
+        self.evaluations: List[tuple] = []
+
+    def _run(self, model, tag):
+        ev = model.evaluate(self.test_data)
+        self.evaluations.append((tag, ev))
+        msg = f"Evaluation at {tag}: accuracy {ev.accuracy():.4f} f1 {ev.f1():.4f}"
+        log.info(msg)
+        print(msg)
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.invocation == "iteration" and iteration % self.frequency == 0:
+            self._run(model, f"iteration {iteration}")
+
+    def on_epoch_end(self, model):
+        if self.invocation == "epoch":
+            self._run(model, f"epoch {model.epoch}")
+
+
+class CheckpointListener(IterationListener):
+    """Periodic model checkpoints (parity: CheckpointListener — keeps last N
+    zips in a directory)."""
+
+    def __init__(self, directory: str, every_n_iterations: Optional[int] = None,
+                 every_n_epochs: Optional[int] = None, keep_last: int = 3):
+        import pathlib
+        self.dir = pathlib.Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.keep_last = keep_last
+        self._saved: List = []
+
+    def _save(self, model, tag):
+        path = self.dir / f"checkpoint_{tag}.zip"
+        model.save(str(path))
+        self._saved.append(path)
+        while len(self._saved) > self.keep_last:
+            old = self._saved.pop(0)
+            try:
+                old.unlink()
+            except OSError:
+                pass
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.every_n_iterations and iteration % self.every_n_iterations == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model):
+        if self.every_n_epochs and model.epoch % self.every_n_epochs == 0:
+            self._save(model, f"epoch_{model.epoch}")
+
+
+class TimeIterationListener(IterationListener):
+    """ETA logging (parity: TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self._start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            rate = iteration / elapsed
+            remaining = (self.total - iteration) / rate if rate > 0 else 0
+            msg = (f"iteration {iteration}/{self.total}, elapsed "
+                   f"{elapsed:.0f}s, ETA {remaining:.0f}s")
+            log.info(msg)
+            print(msg)

@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.transferlearning.transfer import (
+    TransferLearning, FineTuneConfiguration, TransferLearningHelper,
+)
+
+__all__ = ["TransferLearning", "FineTuneConfiguration", "TransferLearningHelper"]

@@ -1,0 +1,214 @@
+"""Transfer learning.
+
+Parity surface: reference nn/transferlearning/ — TransferLearning.Builder
+(TransferLearning.java:34: setFeatureExtractor freeze point, nOutReplace,
+removeOutputLayer, addLayer), FineTuneConfiguration (global hyperparameter
+overrides), TransferLearningHelper (featurize: run the frozen front once and
+train only the tail).
+
+TPU design: freezing = wrapping layers in FrozenLayer (stop_gradient + zero
+updater) — parameters are copied by reference (immutable arrays, no clone
+cost).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Optional, List
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.updaters import Updater
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Hyperparameter overrides applied to every retained layer
+    (parity: FineTuneConfiguration.java)."""
+    updater: Optional[Updater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    activation: Optional[str] = None
+    seed: Optional[int] = None
+
+    def apply(self, conf: MultiLayerConfiguration):
+        g = conf.global_conf
+        if self.updater is not None:
+            g.updater = self.updater
+        if self.l1 is not None:
+            g.l1 = self.l1
+        if self.l2 is not None:
+            g.l2 = self.l2
+        if self.seed is not None:
+            g.seed = self.seed
+        for l in conf.layers:
+            if self.updater is not None and l.updater is not None:
+                l.updater = self.updater
+            if self.l1 is not None:
+                l.l1 = self.l1
+            if self.l2 is not None:
+                l.l2 = self.l2
+            if self.dropout is not None and l.dropout is not None:
+                l.dropout = self.dropout
+
+
+class TransferLearning:
+    """Namespace matching the reference API: TransferLearning.Builder(net)."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._conf = MultiLayerConfiguration.from_json(net.conf.to_json())
+            self._params = [p for p in net.params]
+            self._state = [s for s in net.state]
+            self._freeze_until: Optional[int] = None
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._removed_from_end = 0
+            self._added: List = []
+            self._nout_replaced = {}
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0, layer_index] (parity: setFeatureExtractor)."""
+            self._freeze_until = layer_index
+            return self
+
+        def remove_output_layer(self):
+            self._removed_from_end += 1
+            return self
+
+        def remove_layers_from_output(self, n: int):
+            self._removed_from_end += n
+            return self
+
+        def add_layer(self, layer):
+            self._added.append(layer)
+            return self
+
+        def n_out_replace(self, layer_index: int, n_out: int,
+                          weight_init: str = "xavier"):
+            """Re-initialize layer at index with a new n_out (parity:
+            nOutReplace — also fixes the following layer's n_in)."""
+            self._nout_replaced[layer_index] = (n_out, weight_init)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            conf = self._conf
+            layers = conf.layers
+            params = list(self._params)
+            state = list(self._state)
+
+            # remove tail layers
+            for _ in range(self._removed_from_end):
+                layers.pop()
+                params.pop()
+                state.pop()
+
+            # replace n_out (and downstream n_in)
+            reinit = set()
+            for idx, (n_out, winit) in self._nout_replaced.items():
+                layers[idx].n_out = n_out
+                layers[idx].weight_init = winit
+                reinit.add(idx)
+                if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
+                    layers[idx + 1].n_in = n_out
+                    reinit.add(idx + 1)
+
+            # append new layers (shape-infer their n_in from predecessor)
+            it = None
+            if conf.input_type is not None:
+                it = conf.input_type
+                for l in layers:
+                    it = l.output_type(it)
+            for l in self._added:
+                l.apply_defaults(conf.global_conf.defaults_dict())
+                if it is not None:
+                    l.set_n_in(it)
+                    it = l.output_type(it)
+                layers.append(l)
+                params.append(None)  # init below
+                state.append(l.init_state())
+
+            # freeze front
+            if self._freeze_until is not None:
+                for i in range(min(self._freeze_until + 1, len(layers))):
+                    if not isinstance(layers[i], FrozenLayer):
+                        layers[i] = FrozenLayer(inner=layers[i])
+
+            if self._fine_tune is not None:
+                self._fine_tune.apply(conf)
+
+            conf._finalized = True
+            net = MultiLayerNetwork(conf)
+            rng = jax.random.PRNGKey(conf.global_conf.seed)
+            keys = jax.random.split(rng, max(len(layers), 1))
+            new_params = []
+            for i, l in enumerate(layers):
+                if i < len(params) and params[i] is not None and i not in reinit:
+                    new_params.append(params[i])
+                else:
+                    new_params.append(l.init(keys[i]))
+            net.params = new_params
+            net.state = state
+            net._build_optimizer()
+            return net
+
+
+class TransferLearningHelper:
+    """Featurization helper (parity: TransferLearningHelper.java): run the
+    frozen front once per dataset, train only the unfrozen tail on the cached
+    features."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: Optional[int] = None):
+        if frozen_until is None:
+            # infer: leading FrozenLayer prefix
+            frozen_until = -1
+            for i, l in enumerate(net.layers):
+                if isinstance(l, FrozenLayer):
+                    frozen_until = i
+                else:
+                    break
+        self.frozen_until = frozen_until
+        self.full_net = net
+        # tail network over the unfrozen suffix
+        conf = MultiLayerConfiguration.from_json(net.conf.to_json())
+        tail_layers = conf.layers[frozen_until + 1:]
+        tail_conf = MultiLayerConfiguration(
+            global_conf=conf.global_conf, layers=tail_layers,
+            input_type=None, backprop_type=conf.backprop_type,
+            tbptt_fwd_length=conf.tbptt_fwd_length,
+            tbptt_back_length=conf.tbptt_back_length)
+        tail_conf._finalized = True
+        self.unfrozen = MultiLayerNetwork(tail_conf)
+        self.unfrozen.params = list(net.params[frozen_until + 1:])
+        self.unfrozen.state = list(net.state[frozen_until + 1:])
+        self.unfrozen._build_optimizer()
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        import jax.numpy as jnp
+        x = jnp.asarray(ds.features)
+        act, _, _ = self.full_net._forward(
+            self.full_net.params, self.full_net.state, x, train=False,
+            rng=None, upto=self.frozen_until + 1)
+        import numpy as np
+        return DataSet(np.asarray(act), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def fit_featurized(self, ds: DataSet):
+        self.unfrozen.fit(ds)
+        # write trained tail params back into the full net
+        for i, p in enumerate(self.unfrozen.params):
+            self.full_net.params[self.frozen_until + 1 + i] = p
+        return self
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self.unfrozen

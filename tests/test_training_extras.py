@@ -1,0 +1,146 @@
+"""Listeners, early stopping, transfer learning
+(parity role: reference listener/earlystopping/transferlearning test suites)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.data.fetchers import load_iris
+from deeplearning4j_tpu.optimize import (
+    ScoreIterationListener, CollectScoresIterationListener, PerformanceListener,
+    EvaluativeListener, CheckpointListener,
+)
+from deeplearning4j_tpu.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition, DataSetLossCalculator,
+    InMemoryModelSaver, LocalFileModelSaver,
+)
+from deeplearning4j_tpu.transferlearning import (
+    TransferLearning, FineTuneConfiguration, TransferLearningHelper,
+)
+
+
+def _net(n_in=4, n_hidden=16, n_out=3, seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=n_hidden, activation="relu"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_listeners_fire():
+    x, y = load_iris()
+    net = _net()
+    collect = CollectScoresIterationListener()
+    net.set_listeners(ScoreIterationListener(5), collect, PerformanceListener(5))
+    for _ in range(12):
+        net.fit(DataSet(x, y))
+    assert len(collect.scores) == 12
+    assert collect.scores[-1][1] < collect.scores[0][1]
+
+
+def test_evaluative_and_checkpoint_listeners(tmp_path):
+    x, y = load_iris()
+    ds = DataSet(x, y)
+    net = _net()
+    ev = EvaluativeListener(ds, frequency=5)
+    cp = CheckpointListener(str(tmp_path), every_n_iterations=4, keep_last=2)
+    net.set_listeners(ev, cp)
+    for _ in range(10):
+        net.fit(ds)
+    assert len(ev.evaluations) == 2
+    zips = list(tmp_path.glob("*.zip"))
+    assert len(zips) == 2  # keep_last enforced
+
+
+def test_early_stopping_max_epochs(tmp_path):
+    x, y = load_iris()
+    it = ListDataSetIterator(DataSet(x, y), 50)
+    net = _net()
+    esc = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(DataSet(x, y), 150)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+        iteration_termination_conditions=[InvalidScoreIterationTerminationCondition()],
+        model_saver=LocalFileModelSaver(str(tmp_path)))
+    result = EarlyStoppingTrainer(esc, net, it).fit()
+    assert result.total_epochs == 5
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.best_model is not None
+    assert (tmp_path / "bestModel.zip").exists()
+    assert len(result.score_vs_epoch) == 5
+
+
+def test_early_stopping_no_improvement():
+    x, y = load_iris()
+    it = ListDataSetIterator(DataSet(x, y), 150)
+    net = _net()
+    esc = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(DataSet(x, y), 150)),
+        epoch_termination_conditions=[
+            MaxEpochsTerminationCondition(100),
+            ScoreImprovementEpochTerminationCondition(3, min_improvement=10.0)],
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingTrainer(esc, net, it).fit()
+    # improvement threshold of 10 nats/epoch is unattainable → stops at 3
+    assert result.total_epochs <= 5
+    assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+
+
+def test_transfer_learning_freeze_and_replace_head():
+    x, y = load_iris()
+    base = _net()
+    for _ in range(60):
+        base.fit(DataSet(x, y))
+    w0_before = np.asarray(base.params[0]["W"])
+
+    new_net = (TransferLearning.Builder(base)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.05)))
+               .set_feature_extractor(0)
+               .remove_output_layer()
+               .add_layer(OutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+               .build())
+    assert isinstance(new_net.layers[0], FrozenLayer)
+    assert len(new_net.layers) == 3
+    for _ in range(20):
+        new_net.fit(DataSet(x, y))
+    # frozen layer params unchanged, head params trained
+    assert np.allclose(np.asarray(new_net.params[0]["W"]), w0_before)
+    assert new_net.evaluate(DataSet(x, y)).accuracy() > 0.8
+
+
+def test_transfer_nout_replace():
+    base = _net()
+    new_net = (TransferLearning.Builder(base)
+               .n_out_replace(1, 12, "xavier")
+               .build())
+    assert new_net.layers[1].n_out == 12
+    assert new_net.layers[2].n_in == 12
+    out = new_net.output(np.random.rand(3, 4).astype(np.float32))
+    assert out.shape == (3, 3)
+
+
+def test_transfer_learning_helper_featurize():
+    x, y = load_iris()
+    base = _net()
+    frozen = (TransferLearning.Builder(base)
+              .set_feature_extractor(1)
+              .build())
+    helper = TransferLearningHelper(frozen)
+    feats = helper.featurize(DataSet(x, y))
+    assert feats.features.shape == (150, 8)
+    s_before = frozen.score(DataSet(x, y))
+    for _ in range(40):
+        helper.fit_featurized(feats)
+    assert frozen.score(DataSet(x, y)) < s_before
