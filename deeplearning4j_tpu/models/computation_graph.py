@@ -57,7 +57,7 @@ class ComputationGraph:
         for name, k in zip(layer_nodes, keys):
             l = self.conf.nodes[name].layer
             self.params[name] = l.init(k, dtype)
-            self.state[name] = l.init_state()
+            self.state[name] = l.init_state(dtype)
         self._build_optimizer()
         return self
 

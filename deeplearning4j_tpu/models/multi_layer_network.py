@@ -60,7 +60,7 @@ class MultiLayerNetwork:
             rng = jax.random.PRNGKey(gc.seed)
         keys = jax.random.split(rng, max(len(self.layers), 1))
         self.params = [l.init(k, dtype) for l, k in zip(self.layers, keys)]
-        self.state = [l.init_state() for l in self.layers]
+        self.state = [l.init_state(dtype) for l in self.layers]
         self._build_optimizer()
         return self
 

@@ -73,7 +73,7 @@ class Layer:
     def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
         return {}
 
-    def init_state(self) -> Dict[str, Any]:
+    def init_state(self, dtype=jnp.float32) -> Dict[str, Any]:
         return {}
 
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):

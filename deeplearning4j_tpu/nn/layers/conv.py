@@ -456,8 +456,9 @@ class BatchNormalization(Layer):
         return {"gamma": jnp.ones((self.n_in,), dtype),
                 "beta": jnp.zeros((self.n_in,), dtype)}
 
-    def init_state(self):
-        return {"mean": jnp.zeros((self.n_in,)), "var": jnp.ones((self.n_in,))}
+    def init_state(self, dtype=jnp.float32):
+        return {"mean": jnp.zeros((self.n_in,), dtype),
+                "var": jnp.ones((self.n_in,), dtype)}
 
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))

@@ -321,8 +321,9 @@ class FrozenLayer(Layer):
     def init(self, rng, dtype=jnp.float32):
         return self.inner.init(rng, dtype)
 
-    def init_state(self):
-        return self.inner.init_state()
+    def init_state(self, dtype=None):
+        import jax.numpy as jnp
+        return self.inner.init_state(dtype or jnp.float32)
 
     def has_params(self):
         return self.inner.has_params()
