@@ -1,0 +1,32 @@
+"""NLP / embeddings.
+
+Parity surface: reference deeplearning4j-nlp-parent/deeplearning4j-nlp —
+SequenceVectors framework (SequenceVectors.java:192 fit), Word2Vec,
+ParagraphVectors, GloVe, vocab construction, tokenization, sentence
+iteration, and WordVectorSerializer.
+
+TPU design: the reference trains embeddings with N Java threads doing lock-
+free per-word updates through a native AggregateSkipGram op. Here training is
+BATCHED: (center, context, negatives) index arrays are assembled on host and
+one jit'd step does gathers + dot products + scatter-adds on device — the
+embedding matrices live in device HBM and the hot loop is a single XLA
+program per batch.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator, BasicLineIterator, FileSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord, VocabConstructor
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory",
+           "CollectionSentenceIterator", "BasicLineIterator",
+           "FileSentenceIterator", "VocabCache", "VocabWord",
+           "VocabConstructor", "Word2Vec", "ParagraphVectors", "Glove",
+           "WordVectorSerializer"]

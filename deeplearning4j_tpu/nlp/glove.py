@@ -1,0 +1,101 @@
+"""GloVe embeddings.
+
+Parity surface: reference models/glove/Glove.java + AbstractCoOccurrences —
+co-occurrence counting over a window with 1/d weighting, then AdaGrad on the
+weighted least-squares objective f(X_ij)(w_i·w~_j + b_i + b~_j - log X_ij)².
+
+TPU design: co-occurrence counting on host (hash map), training as batched
+jit'd AdaGrad over (i, j, X_ij) triples.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wc, b, bc, gw, gwc, gb, gbc, ii, jj, logx, fx, lr):
+    """AdaGrad batch on the GloVe objective."""
+    wi = w[ii]
+    wj = wc[jj]
+    diff = (wi * wj).sum(-1) + b[ii] + bc[jj] - logx     # (B,)
+    fdiff = fx * diff
+    gi = fdiff[:, None] * wj
+    gj = fdiff[:, None] * wi
+    # adagrad accumulators
+    gw = gw.at[ii].add(gi ** 2)
+    gwc = gwc.at[jj].add(gj ** 2)
+    gb = gb.at[ii].add(fdiff ** 2)
+    gbc = gbc.at[jj].add(fdiff ** 2)
+    w = w.at[ii].add(-lr * gi / jnp.sqrt(gw[ii] + 1e-8))
+    wc = wc.at[jj].add(-lr * gj / jnp.sqrt(gwc[jj] + 1e-8))
+    b = b.at[ii].add(-lr * fdiff / jnp.sqrt(gb[ii] + 1e-8))
+    bc = bc.at[jj].add(-lr * fdiff / jnp.sqrt(gbc[jj] + 1e-8))
+    return w, wc, b, bc, gw, gwc, gb, gbc
+
+
+class Glove(Word2Vec):
+    def __init__(self, x_max=100.0, alpha=0.75, learning_rate=0.05, epochs=5,
+                 symmetric=True, **kwargs):
+        kwargs.setdefault("batch_size", 4096)
+        super().__init__(learning_rate=learning_rate, epochs=epochs, **kwargs)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+
+    def _cooccurrences(self, seqs):
+        counts = defaultdict(float)
+        for seq in seqs:
+            n = len(seq)
+            for i in range(n):
+                for j in range(max(0, i - self.window_size), i):
+                    d = i - j
+                    counts[(int(seq[i]), int(seq[j]))] += 1.0 / d
+                    if self.symmetric:
+                        counts[(int(seq[j]), int(seq[i]))] += 1.0 / d
+        ii = np.fromiter((k[0] for k in counts), np.int32, len(counts))
+        jj = np.fromiter((k[1] for k in counts), np.int32, len(counts))
+        xx = np.fromiter(counts.values(), np.float32, len(counts))
+        return ii, jj, xx
+
+    def fit(self):
+        if self.vocab is None:
+            self.build_vocab()
+        seqs = self._encode_corpus()
+        ii, jj, xx = self._cooccurrences(seqs)
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.RandomState(self.seed)
+        w = jnp.asarray((rng.rand(V, D).astype(np.float32) - 0.5) / D)
+        wc = jnp.asarray((rng.rand(V, D).astype(np.float32) - 0.5) / D)
+        b = jnp.zeros(V, jnp.float32)
+        bc = jnp.zeros(V, jnp.float32)
+        gw = jnp.full((V, D), 1e-8, jnp.float32)
+        gwc = jnp.full((V, D), 1e-8, jnp.float32)
+        gb = jnp.full(V, 1e-8, jnp.float32)
+        gbc = jnp.full(V, 1e-8, jnp.float32)
+
+        logx = np.log(np.maximum(xx, 1e-10))
+        fx = np.minimum((xx / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+        n = len(ii)
+        bs = self._effective_batch()
+        for ep in range(self.epochs):
+            order = rng.permutation(n)
+            for s in range(0, n, bs):
+                sel = order[s:s + bs]
+                w, wc, b, bc, gw, gwc, gb, gbc = _glove_step(
+                    w, wc, b, bc, gw, gwc, gb, gbc,
+                    jnp.asarray(ii[sel]), jnp.asarray(jj[sel]),
+                    jnp.asarray(logx[sel]), jnp.asarray(fx[sel]),
+                    jnp.float32(self.learning_rate))
+        self.syn0 = w + wc  # standard GloVe: sum of both tables
+        self.syn1 = wc
+        self._norm_cache = None
+        return self

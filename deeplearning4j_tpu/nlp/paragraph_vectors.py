@@ -1,0 +1,169 @@
+"""ParagraphVectors (doc2vec) — PV-DBOW and PV-DM.
+
+Parity surface: reference models/paragraphvectors/ParagraphVectors.java
+(1,461 LoC), learning algorithms DBOW.java / DM.java, inferVector.
+
+Batched TPU formulation like word2vec: PV-DBOW is skip-gram where the
+"center" is the document vector; PV-DM predicts the center word from the
+mean of (context words + doc vector).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.word2vec import (
+    Word2Vec, _sg_neg_step, _cbow_neg_step, _sg_infer_step,
+)
+
+
+class ParagraphVectors(Word2Vec):
+    """labels: one label per document (parity: LabelledDocument /
+    LabelsSource). ``sentences`` = list of document strings."""
+
+    def __init__(self, sequences_learning_algorithm="dbow", labels=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.seq_algorithm = sequences_learning_algorithm.lower()
+        self.labels = labels
+        self.doc_vecs = None
+        self._label_index: Dict[str, int] = {}
+
+    def _doc_labels(self, n_docs):
+        if self.labels is not None:
+            labels = list(self.labels)
+        else:
+            labels = [f"DOC_{i}" for i in range(n_docs)]
+        self._label_index = {l: i for i, l in enumerate(labels)}
+        return labels
+
+    def fit(self):
+        if self.vocab is None:
+            self.build_vocab()
+        if self.syn0 is None:
+            self._init_tables()
+        seqs = self._encode_corpus()
+        self._doc_labels(len(seqs))
+        rng = np.random.RandomState(self.seed + 41)
+        D = self.layer_size
+        self.doc_vecs = jnp.asarray(
+            (rng.rand(len(seqs), D).astype(np.float32) - 0.5) / D)
+        key = jax.random.PRNGKey(self.seed + 1)
+
+        # PV-DBOW: (doc, word) pairs through the skip-gram kernel with the
+        # doc table as syn0. PV-DM: cbow kernel with doc vector appended to
+        # the context window (index into a concatenated [syn0; doc] table).
+        if self.seq_algorithm == "dbow":
+            docs, words = [], []
+            for d, seq in enumerate(seqs):
+                docs.extend([d] * len(seq))
+                words.extend(seq.tolist())
+            docs = np.asarray(docs, np.int32)
+            words = np.asarray(words, np.int32)
+            n = len(docs)
+            bs = self._effective_batch()
+            total = max(1, self.epochs * ((n + bs - 1) // bs))
+            step_i = 0
+            for ep in range(self.epochs):
+                order = rng.permutation(n)
+                for s in range(0, n, bs):
+                    sel = order[s:s + bs]
+                    lr = max(self.min_learning_rate,
+                             self.learning_rate * (1 - step_i / total))
+                    key, sub = jax.random.split(key)
+                    self.doc_vecs, self.syn1 = _sg_neg_step(
+                        self.doc_vecs, self.syn1, self._table,
+                        jnp.asarray(docs[sel]), jnp.asarray(words[sel]),
+                        jnp.float32(lr), sub, self.negative)
+                    step_i += 1
+            # also train word vectors (reference trainWordVectors=true default)
+            super().fit()
+        else:  # dm
+            V = self.vocab.num_words()
+            W = 2 * self.window_size + 1  # context + doc slot
+            ctxs, masks, targets = [], [], []
+            for d, seq in enumerate(seqs):
+                n = len(seq)
+                wins = rng.randint(1, self.window_size + 1, size=n)
+                for i in range(n):
+                    w = wins[i]
+                    lo, hi = max(0, i - w), min(n, i + w + 1)
+                    window = [seq[j] for j in range(lo, hi) if j != i]
+                    row = np.zeros(W, np.int32)
+                    m = np.zeros(W, np.float32)
+                    row[0] = V + d  # doc vector slot
+                    m[0] = 1.0
+                    row[1:1 + len(window)] = window[:W - 1]
+                    m[1:1 + len(window)] = 1.0
+                    ctxs.append(row)
+                    masks.append(m)
+                    targets.append(seq[i])
+            ctxs = np.asarray(ctxs)
+            masks = np.asarray(masks)
+            targets = np.asarray(targets, np.int32)
+            combined = jnp.concatenate([self.syn0, self.doc_vecs], axis=0)
+            n = len(targets)
+            bs = self._effective_batch()
+            total = max(1, self.epochs * ((n + bs - 1) // bs))
+            step_i = 0
+            for ep in range(self.epochs):
+                order = rng.permutation(n)
+                for s in range(0, n, bs):
+                    sel = order[s:s + bs]
+                    lr = max(self.min_learning_rate,
+                             self.learning_rate * (1 - step_i / total))
+                    key, sub = jax.random.split(key)
+                    combined, self.syn1 = _cbow_neg_step(
+                        combined, self.syn1, self._table,
+                        jnp.asarray(ctxs[sel]), jnp.asarray(masks[sel]),
+                        jnp.asarray(targets[sel]), jnp.float32(lr), sub,
+                        self.negative)
+                    step_i += 1
+            self.syn0 = combined[:V]
+            self.doc_vecs = combined[V:]
+        self._norm_cache = None
+        return self
+
+    # ------------------------------------------------------------ query API
+    def doc_vector(self, label) -> Optional[np.ndarray]:
+        i = self._label_index.get(label)
+        return None if i is None else np.asarray(self.doc_vecs[i])
+
+    def infer_vector(self, text, steps: int = 20, lr: float = 0.05):
+        """Infer a vector for unseen text: gradient steps on a fresh doc
+        vector with frozen word/context tables (parity: inferVector)."""
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        idx = [self.vocab.index_of(t) for t in toks]
+        idx = np.asarray([i for i in idx if i >= 0], np.int32)
+        if len(idx) == 0:
+            return np.zeros(self.layer_size, np.float32)
+        rng = np.random.RandomState(self.seed + 97)
+        dv = jnp.asarray((rng.rand(1, self.layer_size).astype(np.float32) - 0.5)
+                         / self.layer_size)
+        key = jax.random.PRNGKey(self.seed + 5)
+        syn1 = self.syn1
+        docs = jnp.zeros(len(idx), jnp.int32)
+        words = jnp.asarray(idx)
+        for s in range(steps):
+            key, sub = jax.random.split(key)
+            dv = _sg_infer_step(dv, syn1, self._table, docs, words,
+                                jnp.float32(lr * (1 - s / steps) + 1e-4),
+                                sub, self.negative)
+        return np.asarray(dv[0])
+
+    def nearest_labels(self, text_or_vec, n=5) -> List[str]:
+        if isinstance(text_or_vec, str):
+            q = self.infer_vector(text_or_vec)
+        else:
+            q = np.asarray(text_or_vec)
+        q = q / max(np.linalg.norm(q), 1e-9)
+        m = np.asarray(self.doc_vecs)
+        m = m / np.maximum(np.linalg.norm(m, axis=1, keepdims=True), 1e-9)
+        sims = m @ q
+        order = np.argsort(-sims)[:n]
+        inv = {v: k for k, v in self._label_index.items()}
+        return [inv[int(i)] for i in order]

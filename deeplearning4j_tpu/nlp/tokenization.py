@@ -1,0 +1,80 @@
+"""Tokenization.
+
+Parity surface: reference text/tokenization/ — TokenizerFactory SPI,
+DefaultTokenizerFactory (whitespace+punct), NGramTokenizerFactory,
+preprocessors (CommonPreprocessor lowercases + strips punctuation).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Callable
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation (parity: CommonPreprocessor)."""
+
+    _PUNCT = re.compile(r"[^\w\s]|_", re.UNICODE)
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class Tokenizer:
+    def __init__(self, tokens: List[str]):
+        self._tokens = tokens
+        self._pos = 0
+
+    def has_more_tokens(self) -> bool:
+        return self._pos < len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._pos]
+        self._pos += 1
+        return t
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+
+class DefaultTokenizerFactory:
+    """Whitespace tokenizer with optional preprocessor
+    (parity: DefaultTokenizerFactory)."""
+
+    def __init__(self):
+        self._pre: Optional[Callable] = None
+
+    def set_token_pre_processor(self, pre):
+        self._pre = pre
+        return self
+
+    def create(self, text: str) -> Tokenizer:
+        toks = text.split()
+        if self._pre is not None:
+            toks = [self._pre.pre_process(t) for t in toks]
+            toks = [t for t in toks if t]
+        return Tokenizer(toks)
+
+
+class NGramTokenizerFactory:
+    """Word n-grams over a base tokenizer (parity: NGramTokenizerFactory)."""
+
+    def __init__(self, base_factory, min_n: int, max_n: int):
+        self.base = base_factory
+        self.min_n = min_n
+        self.max_n = max_n
+
+    def set_token_pre_processor(self, pre):
+        self.base.set_token_pre_processor(pre)
+        return self
+
+    def create(self, text: str) -> Tokenizer:
+        words = self.base.create(text).get_tokens()
+        out = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(words) - n + 1):
+                out.append(" ".join(words[i:i + n]))
+        return Tokenizer(out)

@@ -1,0 +1,369 @@
+"""Word2Vec — skip-gram / CBOW with negative sampling or hierarchical softmax.
+
+Parity surface: reference models/word2vec/Word2Vec.java (builder),
+models/embeddings/learning/impl/elements/SkipGram.java (287 LoC) + CBOW.java,
+InMemoryLookupTable (syn0/syn1/syn1neg/expTable), subsampling + lr decay
+(SequenceVectors.fit :192).
+
+TPU design: the reference's VectorCalculationsThreads do lock-free scalar
+updates through the native AggregateSkipGram op. Here the corpus is converted
+into (center, context) index batches on host; ONE jit'd step per batch does
+gather → dot → sigmoid → scatter-add on device arrays. Negative samples are
+drawn on device from the unigram table. This turns a memory-latency-bound
+scalar workload into batched vector ops — the TPU-idiomatic formulation.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache, VocabConstructor, build_huffman, unigram_table,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, CommonPreprocessor,
+)
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
+def _sg_neg_step(syn0, syn1neg, table, centers, contexts, lr, key, negative):
+    """One skip-gram negative-sampling batch.
+    centers/contexts: (B,) int32. Returns updated (syn0, syn1neg)."""
+    B = centers.shape[0]
+    v = syn0[centers]                      # (B, D)
+    # positive pair
+    u_pos = syn1neg[contexts]              # (B, D)
+    s_pos = jax.nn.sigmoid((v * u_pos).sum(-1))
+    g_pos = (1.0 - s_pos) * lr             # (B,)
+    dv = g_pos[:, None] * u_pos
+    du_pos = g_pos[:, None] * v
+    # negatives: (B, K) draws from the unigram table
+    idx = jax.random.randint(key, (B, negative), 0, table.shape[0])
+    negs = table[idx]                      # (B, K)
+    u_neg = syn1neg[negs]                  # (B, K, D)
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))
+    g_neg = -s_neg * lr                    # (B, K)
+    dv = dv + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    du_neg = g_neg[..., None] * v[:, None, :]
+    # scatter updates (duplicate indices accumulate)
+    syn0 = syn0.at[centers].add(dv)
+    syn1neg = syn1neg.at[contexts].add(du_pos)
+    syn1neg = syn1neg.at[negs.reshape(-1)].add(
+        du_neg.reshape(B * negative, -1))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0,))
+def _sg_infer_step(dv, syn1neg, table, docs, words, lr, key, negative):
+    """Skip-gram step that updates ONLY the doc/center table (syn1neg is
+    frozen and NOT donated) — used by ParagraphVectors.infer_vector."""
+    v = dv[docs]
+    u_pos = syn1neg[words]
+    s_pos = jax.nn.sigmoid((v * u_pos).sum(-1))
+    g_pos = (1.0 - s_pos) * lr
+    delta = g_pos[:, None] * u_pos
+    idx = jax.random.randint(key, (docs.shape[0], negative), 0, table.shape[0])
+    negs = table[idx]
+    u_neg = syn1neg[negs]
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))
+    delta = delta + jnp.einsum("bk,bkd->bd", -s_neg * lr, u_neg)
+    return dv.at[docs].add(delta)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
+    """Skip-gram hierarchical-softmax batch.
+    points/codes/code_mask: (B, L) padded Huffman paths of the CONTEXT word;
+    centers: (B,) input word indices."""
+    v = syn0[centers]                      # (B, D)
+    u = syn1[points]                       # (B, L, D)
+    s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
+    # grad of -log p: (1 - code - sigmoid)
+    g = (1.0 - codes - s) * lr * code_mask
+    dv = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * v[:, None, :]
+    syn0 = syn0.at[centers].add(dv)
+    B, L = points.shape
+    syn1 = syn1.at[points.reshape(-1)].add(du.reshape(B * L, -1))
+    return syn0, syn1
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
+def _cbow_neg_step(syn0, syn1neg, table, context_mat, context_mask, targets,
+                   lr, key, negative):
+    """CBOW: mean of context vectors predicts the target word.
+    context_mat: (B, W) int32 padded window indices; context_mask: (B, W)."""
+    B, W = context_mat.shape
+    ctx = syn0[context_mat]                      # (B, W, D)
+    denom = jnp.maximum(context_mask.sum(-1, keepdims=True), 1.0)
+    h = (ctx * context_mask[..., None]).sum(1) / denom   # (B, D)
+    u_pos = syn1neg[targets]
+    s_pos = jax.nn.sigmoid((h * u_pos).sum(-1))
+    g_pos = (1.0 - s_pos) * lr
+    dh = g_pos[:, None] * u_pos
+    du_pos = g_pos[:, None] * h
+    idx = jax.random.randint(key, (B, negative), 0, table.shape[0])
+    negs = table[idx]
+    u_neg = syn1neg[negs]
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+    g_neg = -s_neg * lr
+    dh = dh + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    du_neg = g_neg[..., None] * h[:, None, :]
+    # distribute dh back to context words (divided by window count)
+    dctx = (dh / denom)[:, None, :] * context_mask[..., None]
+    syn0 = syn0.at[context_mat.reshape(-1)].add(dctx.reshape(B * W, -1))
+    syn1neg = syn1neg.at[targets].add(du_pos)
+    syn1neg = syn1neg.at[negs.reshape(-1)].add(du_neg.reshape(B * negative, -1))
+    return syn0, syn1neg
+
+
+class Word2Vec:
+    """Builder-style Word2Vec (parity: Word2Vec.Builder)."""
+
+    def __init__(self, min_word_frequency=5, layer_size=100, window_size=5,
+                 learning_rate=0.025, min_learning_rate=1e-4, negative=5,
+                 use_hierarchic_softmax=False, epochs=1, batch_size=4096,
+                 subsampling=1e-3, seed=123, elements_learning_algorithm="skipgram",
+                 iterate=None, tokenizer_factory=None, sentences=None):
+        self.min_word_frequency = min_word_frequency
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.subsampling = subsampling
+        self.seed = seed
+        self.algorithm = elements_learning_algorithm.lower()
+        self.iterate = iterate
+        self.sentences = sentences
+        self.tokenizer_factory = tokenizer_factory or \
+            DefaultTokenizerFactory().set_token_pre_processor(CommonPreprocessor())
+        self.vocab: Optional[VocabCache] = None
+        self.syn0 = None
+        self.syn1 = None
+        self._norm_cache = None
+
+    # ----------------------------------------------------------- vocab + data
+    def _sequences(self):
+        if self.sentences is not None:
+            src = self.sentences
+        elif self.iterate is not None:
+            src = self.iterate
+        else:
+            raise ValueError("No corpus: provide sentences=[...] or iterate=")
+        for s in src:
+            toks = self.tokenizer_factory.create(s).get_tokens()
+            if toks:
+                yield toks
+
+    def build_vocab(self):
+        self.vocab = VocabConstructor(self.min_word_frequency).build_vocab(
+            self._sequences())
+        if self.use_hs:
+            build_huffman(self.vocab)
+        return self
+
+    def _init_tables(self):
+        rng = np.random.RandomState(self.seed)
+        V, D = self.vocab.num_words(), self.layer_size
+        self.syn0 = jnp.asarray(
+            (rng.rand(V, D).astype(np.float32) - 0.5) / D)
+        self.syn1 = jnp.zeros((V, D), jnp.float32)
+        self._table = jnp.asarray(unigram_table(self.vocab), jnp.int32)
+
+    def _encode_corpus(self):
+        """Corpus → list of index arrays (with subsampling)."""
+        vocab = self.vocab
+        rng = np.random.RandomState(self.seed + 17)
+        total = max(vocab.total_word_count, 1)
+        seqs = []
+        for toks in self._sequences():
+            idx = [vocab.index_of(t) for t in toks]
+            idx = [i for i in idx if i >= 0]
+            if self.subsampling and self.subsampling > 0:
+                kept = []
+                for i in idx:
+                    f = vocab._by_index[i].count / total
+                    p = (math.sqrt(f / self.subsampling) + 1) * self.subsampling / f
+                    if p >= 1.0 or rng.rand() < p:
+                        kept.append(i)
+                idx = kept
+            if len(idx) > 1:
+                seqs.append(np.asarray(idx, np.int32))
+        return seqs
+
+    def _make_pairs(self, seqs, rng):
+        """(center, context) pairs with the reference's randomized effective
+        window (b = random in [1, window])."""
+        centers, contexts = [], []
+        for seq in seqs:
+            n = len(seq)
+            wins = rng.randint(1, self.window_size + 1, size=n)
+            for i in range(n):
+                w = wins[i]
+                lo, hi = max(0, i - w), min(n, i + w + 1)
+                for j in range(lo, hi):
+                    if j != i:
+                        centers.append(seq[i])
+                        contexts.append(seq[j])
+        return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+
+    def _effective_batch(self):
+        """Batched scatter-adds accumulate duplicate-pair updates linearly,
+        where sequential SGD would damp them as sigmoid saturates; with a
+        small vocab this overshoots and collapses the embedding. Cap the
+        batch at 8x vocab so duplicates per batch stay few; large real
+        vocabularies keep the full batch."""
+        return max(64, min(self.batch_size, 8 * self.vocab.num_words()))
+
+    # ------------------------------------------------------------------- fit
+    def fit(self):
+        if self.vocab is None:
+            self.build_vocab()
+        if self.syn0 is None:
+            self._init_tables()
+        seqs = self._encode_corpus()
+        rng = np.random.RandomState(self.seed + 31)
+        key = jax.random.PRNGKey(self.seed)
+
+        if self.use_hs:
+            L = max((len(w.codes) for w in self.vocab.vocab_words()), default=1)
+            V = self.vocab.num_words()
+            pts = np.zeros((V, L), np.int32)
+            cds = np.zeros((V, L), np.float32)
+            msk = np.zeros((V, L), np.float32)
+            for w in self.vocab.vocab_words():
+                l = len(w.codes)
+                # points are inner-node ids; clip negatives (root offset) to 0..V-1
+                pts[w.index, :l] = np.clip(w.points, 0, V - 1)
+                cds[w.index, :l] = w.codes
+                msk[w.index, :l] = 1.0
+            pts_j, cds_j, msk_j = map(jnp.asarray, (pts, cds, msk))
+
+        centers_all, contexts_all = self._make_pairs(seqs, rng)
+        bs = self._effective_batch()
+        n_pairs = len(centers_all)
+        total_steps = max(1, self.epochs * ((n_pairs + bs - 1) // bs))
+        step_i = 0
+        for ep in range(self.epochs):
+            order = rng.permutation(n_pairs)
+            for s in range(0, n_pairs, bs):
+                sel = order[s:s + bs]
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - step_i / total_steps))
+                c = jnp.asarray(centers_all[sel])
+                t = jnp.asarray(contexts_all[sel])
+                key, sub = jax.random.split(key)
+                if self.algorithm == "cbow":
+                    # build window matrices for cbow on the fly
+                    pass
+                if self.use_hs:
+                    self.syn0, self.syn1 = _sg_hs_step(
+                        self.syn0, self.syn1, c, pts_j[t], cds_j[t], msk_j[t],
+                        jnp.float32(lr))
+                else:
+                    self.syn0, self.syn1 = _sg_neg_step(
+                        self.syn0, self.syn1, self._table, c, t,
+                        jnp.float32(lr), sub, self.negative)
+                step_i += 1
+
+        if self.algorithm == "cbow":
+            self._fit_cbow(seqs, rng, key)
+        self._norm_cache = None
+        return self
+
+    def _fit_cbow(self, seqs, rng, key):
+        """CBOW pass: batches of (context window, target)."""
+        W = 2 * self.window_size
+        ctxs, masks, targets = [], [], []
+        for seq in seqs:
+            n = len(seq)
+            wins = rng.randint(1, self.window_size + 1, size=n)
+            for i in range(n):
+                w = wins[i]
+                lo, hi = max(0, i - w), min(n, i + w + 1)
+                window = [seq[j] for j in range(lo, hi) if j != i]
+                if not window:
+                    continue
+                row = np.zeros(W, np.int32)
+                m = np.zeros(W, np.float32)
+                row[:len(window)] = window[:W]
+                m[:len(window)] = 1.0
+                ctxs.append(row)
+                masks.append(m)
+                targets.append(seq[i])
+        ctxs = np.asarray(ctxs)
+        masks = np.asarray(masks)
+        targets = np.asarray(targets, np.int32)
+        n = len(targets)
+        bs = self._effective_batch()
+        total = max(1, self.epochs * ((n + bs - 1) // bs))
+        step_i = 0
+        for ep in range(self.epochs):
+            order = np.random.RandomState(self.seed + ep).permutation(n)
+            for s in range(0, n, bs):
+                sel = order[s:s + bs]
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - step_i / total))
+                key, sub = jax.random.split(key)
+                self.syn0, self.syn1 = _cbow_neg_step(
+                    self.syn0, self.syn1, self._table, jnp.asarray(ctxs[sel]),
+                    jnp.asarray(masks[sel]), jnp.asarray(targets[sel]),
+                    jnp.float32(lr), sub, self.negative)
+                step_i += 1
+
+    # ------------------------------------------------------------ query API
+    def word_vector(self, word) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def has_word(self, word) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def _normed(self):
+        if self._norm_cache is None:
+            m = np.asarray(self.syn0)
+            self._norm_cache = m / np.maximum(
+                np.linalg.norm(m, axis=1, keepdims=True), 1e-9)
+        return self._norm_cache
+
+    def similarity(self, w1, w2) -> float:
+        i, j = self.vocab.index_of(w1), self.vocab.index_of(w2)
+        if i < 0 or j < 0:
+            return float("nan")
+        n = self._normed()
+        return float(n[i] @ n[j])
+
+    def words_nearest(self, word, n=10) -> List[str]:
+        if isinstance(word, str):
+            i = self.vocab.index_of(word)
+            if i < 0:
+                return []
+            q = self._normed()[i]
+            exclude = {i}
+        else:
+            q = np.asarray(word, np.float64)
+            q = q / max(np.linalg.norm(q), 1e-9)
+            exclude = set()
+        sims = self._normed() @ q
+        order = np.argsort(-sims)
+        out = []
+        for idx in order:
+            if idx in exclude:
+                continue
+            out.append(self.vocab.word_at_index(int(idx)))
+            if len(out) >= n:
+                break
+        return out

@@ -1,0 +1,99 @@
+"""NLP embeddings tests (parity role: deeplearning4j-nlp test corpus tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    Word2Vec, ParagraphVectors, Glove, WordVectorSerializer,
+    DefaultTokenizerFactory, CollectionSentenceIterator, VocabConstructor,
+)
+from deeplearning4j_tpu.nlp.vocab import build_huffman, VocabCache
+
+
+def _corpus(n_reps=60):
+    """Tiny synthetic corpus with two clear topic clusters."""
+    a = ["the cat sat on the mat with another cat",
+         "a cat and a kitten play with the mat",
+         "the kitten chased the cat around the mat"]
+    b = ["stocks rose as the market rallied today",
+         "the market fell while stocks dropped today",
+         "investors sold stocks as the market crashed"]
+    return (a + b) * n_reps
+
+
+def test_vocab_and_huffman():
+    sentences = _corpus(2)
+    tf = DefaultTokenizerFactory()
+    seqs = [tf.create(s).get_tokens() for s in sentences]
+    vocab = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+    assert vocab.contains_word("cat")
+    assert vocab.word_frequency("the") > vocab.word_frequency("kitten")
+    build_huffman(vocab)
+    for w in vocab.vocab_words():
+        assert len(w.codes) > 0
+        assert len(w.codes) == len(w.points)
+    # frequent words get shorter codes
+    assert len(vocab.word_for("the").codes) <= len(vocab.word_for("kitten").codes)
+
+
+def test_word2vec_skipgram_clusters():
+    w2v = Word2Vec(min_word_frequency=3, layer_size=32, window_size=3,
+                   epochs=3, negative=5, seed=7, sentences=_corpus(),
+                   subsampling=0)  # tiny corpus: keep all tokens
+    w2v.fit()
+    # same-topic words closer than cross-topic
+    assert w2v.similarity("cat", "kitten") > w2v.similarity("cat", "stocks")
+    assert w2v.similarity("market", "stocks") > w2v.similarity("market", "mat")
+    near = w2v.words_nearest("cat", 5)
+    assert any(w in near for w in ("kitten", "mat"))
+
+
+def test_word2vec_hierarchical_softmax():
+    w2v = Word2Vec(min_word_frequency=3, layer_size=24, window_size=3,
+                   epochs=3, use_hierarchic_softmax=True, seed=7,
+                   sentences=_corpus(), subsampling=0)
+    w2v.fit()
+    assert w2v.similarity("cat", "kitten") > w2v.similarity("cat", "market")
+
+
+def test_word2vec_cbow():
+    w2v = Word2Vec(min_word_frequency=3, layer_size=24, window_size=3,
+                   epochs=3, seed=7, sentences=_corpus(), subsampling=0,
+                   elements_learning_algorithm="cbow")
+    w2v.fit()
+    assert w2v.similarity("stocks", "market") > w2v.similarity("stocks", "kitten")
+
+
+def test_word2vec_serialization(tmp_path):
+    w2v = Word2Vec(min_word_frequency=3, layer_size=16, epochs=1, seed=7,
+                   sentences=_corpus(10), subsampling=0).fit()
+    p = tmp_path / "vectors.txt"
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    assert loaded.has_word("cat")
+    v1 = w2v.word_vector("cat")
+    v2 = loaded.word_vector("cat")
+    assert np.allclose(v1, v2, atol=1e-5)
+    assert loaded.words_nearest("cat", 3) == w2v.words_nearest("cat", 3)
+
+
+def test_paragraph_vectors_dbow():
+    docs = _corpus(20)
+    labels = [f"cats_{i}" if "cat" in d or "kitten" in d else f"fin_{i}"
+              for i, d in enumerate(docs)]
+    pv = ParagraphVectors(min_word_frequency=3, layer_size=24, window_size=3,
+                          epochs=2, seed=7, sentences=docs, labels=labels,
+                          subsampling=0)
+    pv.fit()
+    dv = pv.doc_vector(labels[0])
+    assert dv is not None and dv.shape == (24,)
+    inferred = pv.infer_vector("the cat and the kitten on the mat")
+    assert inferred.shape == (24,)
+    assert np.isfinite(inferred).all()
+
+
+def test_glove():
+    g = Glove(min_word_frequency=3, layer_size=24, window_size=4, epochs=8,
+              seed=7, sentences=_corpus(), subsampling=0)
+    g.fit()
+    assert g.similarity("cat", "kitten") > g.similarity("cat", "stocks")
