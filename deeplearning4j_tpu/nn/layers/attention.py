@@ -1,0 +1,126 @@
+"""Attention layers.
+
+The reference (DL4J 0.9.2) has NO attention layer — long sequences are
+handled only by truncated BPTT (SURVEY.md §5 'long-context'). This module is
+the TPU-first extension the build plan calls for: scaled-dot-product
+multi-head attention that slots into the Layer protocol, with a
+sequence-parallel ring-attention path (parallel/sequence_parallel.py) for
+contexts longer than one chip's HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, require_dims
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+def scaled_dot_product_attention(q, k, v, *, causal=False, mask=None,
+                                 q_offset=0, k_offset=0):
+    """q/k/v: (B, T, H, Dh). mask: (B, Tk) key padding mask. Offsets give
+    global positions for causal masking of sequence blocks."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[1]) + q_offset
+        kpos = jnp.arange(k.shape[1]) + k_offset
+        s = jnp.where(qpos[:, None] >= kpos[None, :], s, -jnp.inf)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :] > 0, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@register_layer
+@dataclass
+class MultiHeadAttention(Layer):
+    """Self-attention over (B, T, C) with n_heads heads. Param keys:
+    Wq/Wk/Wv/Wo (+ biases). Projections are single fused GEMMs on the MXU."""
+    n_in: int = 0
+    n_out: int = 0          # model dim (defaults to n_in)
+    n_heads: int = 4
+    causal: bool = False
+    has_bias: bool = True
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.size or input_type.flat_size()
+        if self.n_out == 0:
+            self.n_out = self.n_in
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out or self.n_in,
+                                   input_type.timeseries_length)
+
+    def init(self, rng, dtype=jnp.float32):
+        require_dims(self, n_in=self.n_in, n_out=self.n_out or self.n_in)
+        if self.n_out == 0:
+            self.n_out = self.n_in
+        if self.n_out % self.n_heads != 0:
+            raise ValueError(f"n_out={self.n_out} not divisible by "
+                             f"n_heads={self.n_heads}")
+        keys = jax.random.split(rng, 4)
+        wi = self.weight_init or "xavier"
+        p = {
+            "Wq": init_weights(keys[0], (self.n_in, self.n_out), wi, self.dist, dtype),
+            "Wk": init_weights(keys[1], (self.n_in, self.n_out), wi, self.dist, dtype),
+            "Wv": init_weights(keys[2], (self.n_in, self.n_out), wi, self.dist, dtype),
+            "Wo": init_weights(keys[3], (self.n_out, self.n_out), wi, self.dist, dtype),
+        }
+        if self.has_bias:
+            p["bq"] = jnp.zeros((self.n_out,), dtype)
+            p["bk"] = jnp.zeros((self.n_out,), dtype)
+            p["bv"] = jnp.zeros((self.n_out,), dtype)
+            p["bo"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def _project(self, params, x):
+        B, T, _ = x.shape
+        H = self.n_heads
+        Dh = self.n_out // H
+        q = x @ params["Wq"]
+        k = x @ params["Wk"]
+        v = x @ params["Wv"]
+        if self.has_bias:
+            q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+        return (q.reshape(B, T, H, Dh), k.reshape(B, T, H, Dh),
+                v.reshape(B, T, H, Dh))
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        B, T, _ = x.shape
+        q, k, v = self._project(params, x)
+        o = scaled_dot_product_attention(q, k, v, causal=self.causal, mask=mask)
+        o = o.reshape(B, T, self.n_out) @ params["Wo"]
+        if self.has_bias:
+            o = o + params["bo"]
+        return o, state
+
+
+@register_layer
+@dataclass
+class LayerNormalization(Layer):
+    """Layer norm over the feature axis (companion to attention stacks)."""
+    n_in: int = 0
+    eps: float = 1e-5
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.size or input_type.flat_size()
+
+    def init(self, rng, dtype=jnp.float32):
+        require_dims(self, n_in=self.n_in)
+        return {"gamma": jnp.ones((self.n_in,), dtype),
+                "beta": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        xn = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        return xn * params["gamma"] + params["beta"], state

@@ -1,0 +1,88 @@
+"""Attention + ring attention (sequence parallelism) tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.nn.layers.attention import (
+    MultiHeadAttention, LayerNormalization, scaled_dot_product_attention,
+)
+from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+def _qkv(B=2, T=16, H=2, Dh=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, Dh).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_ring_attention_matches_full():
+    q, k, v = _qkv()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    full = scaled_dot_product_attention(q, k, v)
+    ring = ring_attention(q, k, v, mesh)
+    assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5), \
+        np.abs(np.asarray(full) - np.asarray(ring)).max()
+
+
+def test_ring_attention_causal_matches_full():
+    q, k, v = _qkv(T=24, seed=3)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    full = scaled_dot_product_attention(q, k, v, causal=True)
+    ring = ring_attention(q, k, v, mesh, causal=True)
+    assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5), \
+        np.abs(np.asarray(full) - np.asarray(ring)).max()
+
+
+def test_ring_attention_two_device_axis():
+    q, k, v = _qkv(T=12, seed=5)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    full = scaled_dot_product_attention(q, k, v, causal=True)
+    ring = ring_attention(q, k, v, mesh, causal=True)
+    assert np.allclose(np.asarray(full), np.asarray(ring), atol=1e-5)
+
+
+def test_mha_layer_in_network():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-3))
+            .list()
+            .layer(MultiHeadAttention(n_heads=2, causal=True))
+            .layer(LayerNormalization())
+            .layer(RnnOutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(3, 6, 8).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.randint(0, 5, (3, 6))]
+    s0 = net.score(x=x, y=y)
+    for _ in range(20):
+        net.fit(x, y)
+    assert net.score(x=x, y=y) < s0
+    out = net.output(x)
+    assert out.shape == (3, 6, 5)
+
+
+def test_mha_gradients():
+    from deeplearning4j_tpu.util.gradient_check import gradient_check_network
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2).updater(Adam(1e-3)).activation("tanh")
+            .list()
+            .layer(MultiHeadAttention(n_heads=2))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 5, 6)
+    y = np.eye(3)[rng.randint(0, 3, (2, 5))]
+    fails, checked, worst = gradient_check_network(net, x, y,
+                                                   max_checks_per_array=10)
+    assert fails == 0, f"{fails}/{checked} failed (worst {worst:.2e})"
