@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.clustering.trees import VPTree, KDTree, QuadTree, SpTree
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.knn import NearestNeighbors
+
+__all__ = ["VPTree", "KDTree", "QuadTree", "SpTree", "KMeansClustering",
+           "NearestNeighbors"]

@@ -1,0 +1,186 @@
+"""t-SNE embedding.
+
+Parity surface: reference deeplearning4j-core plot/Tsne.java +
+plot/BarnesHutTsne.java (868 LoC, SpTree-based O(N log N) repulsion).
+
+TPU design: the exact O(N²) formulation is a handful of GEMMs/softmax-style
+ops that the MXU eats — for the dataset sizes the reference's t-SNE is used
+on (embedding viz, ≤50k points) the dense device path beats host-side
+Barnes-Hut. ``BarnesHutTsne`` (theta>0) keeps the reference's approximate
+algorithm on host via SpTree for API parity and for very large N.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _pairwise_sq_dists(x):
+    n2 = (x ** 2).sum(1)
+    d = n2[:, None] + n2[None, :] - 2.0 * (x @ x.T)
+    return jnp.maximum(d, 0.0)
+
+
+@jax.jit
+def _perplexity_probs(d2, log_perp):
+    """Binary-search per-row precision beta so row entropy = log(perplexity).
+    Vectorized over rows; 50 bisection steps."""
+    n = d2.shape[0]
+    inf_diag = jnp.eye(n) * 1e12
+
+    def row_probs(beta):
+        p = jnp.exp(-(d2 + inf_diag) * beta[:, None])
+        psum = p.sum(1, keepdims=True)
+        return p / jnp.maximum(psum, 1e-30)
+
+    def entropy(beta):
+        p = row_probs(beta)
+        return -(p * jnp.log(jnp.maximum(p, 1e-30))).sum(1)
+
+    def body(_, carry):
+        lo, hi, beta = carry
+        h = entropy(beta)
+        too_high = h > log_perp  # entropy too high → increase beta
+        lo = jnp.where(too_high, beta, lo)
+        hi = jnp.where(too_high, hi, beta)
+        beta = jnp.where(jnp.isinf(hi), beta * 2,
+                         jnp.where(jnp.isinf(lo), beta / 2, (lo + hi) / 2))
+        return lo, hi, beta
+
+    lo = jnp.full((n,), -jnp.inf)
+    hi = jnp.full((n,), jnp.inf)
+    beta = jnp.ones((n,))
+    _, _, beta = jax.lax.fori_loop(0, 50, body, (lo, hi, beta))
+    return row_probs(beta)
+
+
+@jax.jit
+def _tsne_grad(y, P):
+    d2 = _pairwise_sq_dists(y)
+    n = y.shape[0]
+    q_num = 1.0 / (1.0 + d2)
+    q_num = q_num * (1.0 - jnp.eye(n))
+    Q = q_num / jnp.maximum(q_num.sum(), 1e-30)
+    PQ = (P - jnp.maximum(Q, 1e-30)) * q_num
+    grad = 4.0 * ((jnp.diag(PQ.sum(1)) - PQ) @ y)
+    kl = (P * jnp.log(jnp.maximum(P, 1e-30) / jnp.maximum(Q, 1e-30))).sum()
+    return grad, kl
+
+
+class Tsne:
+    """Exact t-SNE on device (parity: plot/Tsne.java API)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 max_iter: int = 500, learning_rate: float = 200.0,
+                 momentum: float = 0.8, early_exaggeration: float = 12.0,
+                 exaggeration_iters: int = 100, seed: int = 123):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.max_iter = max_iter
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.early_exaggeration = early_exaggeration
+        self.exaggeration_iters = exaggeration_iters
+        self.seed = seed
+        self.embedding: Optional[np.ndarray] = None
+        self.kl: float = float("nan")
+
+    def _p_matrix(self, x):
+        d2 = _pairwise_sq_dists(jnp.asarray(x, jnp.float32))
+        P = _perplexity_probs(d2, jnp.log(self.perplexity))
+        P = (P + P.T) / (2.0 * P.shape[0])
+        return jnp.maximum(P, 1e-12)
+
+    def fit(self, x):
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        P = self._p_matrix(x)
+        rng = np.random.RandomState(self.seed)
+        y = jnp.asarray(rng.randn(n, self.n_components).astype(np.float32)
+                        * 1e-2)
+        vel = jnp.zeros_like(y)
+        for it in range(self.max_iter):
+            Pc = P * self.early_exaggeration if it < self.exaggeration_iters else P
+            grad, kl = _tsne_grad(y, Pc)
+            mom = 0.5 if it < 20 else self.momentum
+            vel = mom * vel - self.learning_rate * grad
+            y = y + vel
+            y = y - y.mean(0)
+        self.embedding = np.asarray(y)
+        self.kl = float(kl)
+        return self.embedding
+
+    fit_transform = fit
+
+    def plot(self, x=None):
+        if self.embedding is None and x is not None:
+            self.fit(x)
+        return self.embedding
+
+
+class BarnesHutTsne(Tsne):
+    """Barnes-Hut approximate t-SNE (parity: plot/BarnesHutTsne.java).
+    theta=0 falls back to the exact device path."""
+
+    def __init__(self, theta: float = 0.5, **kwargs):
+        kwargs.setdefault("max_iter", 300)
+        super().__init__(**kwargs)
+        self.theta = theta
+
+    def fit(self, x):
+        if self.theta <= 0:
+            return super().fit(x)
+        from deeplearning4j_tpu.clustering.trees import SpTree
+        from deeplearning4j_tpu.clustering.knn import NearestNeighbors
+
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        k = min(n - 1, int(3 * self.perplexity))
+        nn = NearestNeighbors(x)
+        idx, _ = nn.knn(x, k + 1)
+        # sparse P from kNN graph (device perplexity solve on the kNN dists)
+        d2_full = np.asarray(_pairwise_sq_dists(jnp.asarray(x)))
+        P = np.zeros((n, n), np.float64)
+        Pcond = np.asarray(_perplexity_probs(jnp.asarray(d2_full),
+                                             jnp.log(self.perplexity)))
+        mask = np.zeros((n, n), bool)
+        for i in range(n):
+            mask[i, idx[i, 1:]] = True
+        Pcond = Pcond * mask
+        P = (Pcond + Pcond.T)
+        P /= max(P.sum(), 1e-12)
+        P = np.maximum(P, 1e-12)
+
+        rng = np.random.RandomState(self.seed)
+        y = rng.randn(n, self.n_components) * 1e-2
+        vel = np.zeros_like(y)
+        rows, cols = P.nonzero()
+        pvals = P[rows, cols]
+        for it in range(self.max_iter):
+            ex = self.early_exaggeration if it < self.exaggeration_iters else 1.0
+            tree = SpTree(y)
+            # attractive forces over sparse edges
+            diff = y[rows] - y[cols]
+            q = 1.0 / (1.0 + (diff ** 2).sum(1))
+            att = np.zeros_like(y)
+            w = (ex * pvals * q)[:, None] * diff
+            np.add.at(att, rows, w)
+            # repulsive via Barnes-Hut
+            rep = np.zeros_like(y)
+            sum_q = 0.0
+            for i in range(n):
+                neg, sq = tree.compute_non_edge_forces(y[i], self.theta)
+                rep[i] = neg
+                sum_q += sq
+            grad = 4.0 * (att - rep / max(sum_q, 1e-12))
+            mom = 0.5 if it < 20 else self.momentum
+            vel = mom * vel - self.learning_rate * grad
+            y = y + vel
+            y = y - y.mean(0)
+        self.embedding = y.astype(np.float32)
+        return self.embedding

@@ -1,0 +1,107 @@
+"""Clustering/KNN/t-SNE tests (parity role: nearestneighbor-core + plot tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    VPTree, KDTree, SpTree, QuadTree, KMeansClustering, NearestNeighbors,
+)
+from deeplearning4j_tpu.plot import Tsne, BarnesHutTsne
+
+
+def _blobs(n_per=50, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.array([[0] * d, [10] + [0] * (d - 1), [0, 10] + [0] * (d - 2)],
+                       np.float64)
+    pts = np.concatenate([c + rng.randn(n_per, d) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels
+
+
+def _brute_knn(pts, q, k):
+    d = np.sqrt(((pts - q) ** 2).sum(1))
+    order = np.argsort(d)[:k]
+    return list(order), list(d[order])
+
+
+def test_vptree_exact():
+    pts, _ = _blobs()
+    tree = VPTree(pts)
+    q = pts[7] + 0.01
+    idx, dist = tree.knn(q, 5)
+    bidx, bdist = _brute_knn(pts, q, 5)
+    assert set(idx) == set(bidx)
+    assert np.allclose(sorted(dist), sorted(bdist), atol=1e-9)
+
+
+def test_vptree_cosine():
+    pts, _ = _blobs(seed=3)
+    tree = VPTree(pts, distance="cosine")
+    idx, dist = tree.knn(pts[0], 3)
+    normed = pts / np.linalg.norm(pts, axis=1, keepdims=True)
+    bd = 1 - normed @ (pts[0] / np.linalg.norm(pts[0]))
+    assert set(idx) == set(np.argsort(bd)[:3])
+
+
+def test_kdtree_exact():
+    pts, _ = _blobs(seed=1)
+    tree = KDTree(pts)
+    q = pts[33] + 0.05
+    idx, dist = tree.knn(q, 4)
+    bidx, _ = _brute_knn(pts, q, 4)
+    assert set(idx) == set(bidx)
+
+
+def test_device_knn_matches_brute():
+    pts, _ = _blobs(seed=2)
+    nn = NearestNeighbors(pts)
+    idx, dist = nn.knn(pts[:10], 6)
+    for qi in range(10):
+        bidx, bdist = _brute_knn(pts.astype(np.float32), pts[qi].astype(np.float32), 6)
+        assert set(idx[qi]) == set(bidx)
+        assert np.allclose(sorted(dist[qi]), sorted(bdist), atol=1e-3)
+
+
+def test_kmeans_recovers_blobs():
+    pts, labels = _blobs(n_per=60, seed=4)
+    km = KMeansClustering(k=3, seed=5).apply_to(pts)
+    assert km.centroids.shape == (3, 4)
+    # each true cluster maps to one kmeans cluster almost purely
+    for c in range(3):
+        assign = km.assignments[labels == c]
+        dominant = np.bincount(assign).max()
+        assert dominant / len(assign) > 0.95
+    pred = km.predict(pts[:5])
+    assert pred.shape == (5,)
+
+
+def test_quadtree_and_sptree():
+    pts2 = _blobs(n_per=30, d=2, seed=6)[0]
+    qt = QuadTree(pts2)
+    assert qt.root.count == len(pts2)
+    st = SpTree(pts2)
+    neg, sum_q = st.compute_non_edge_forces(pts2[0], theta=0.5)
+    assert neg.shape == (2,)
+    assert sum_q > 0
+
+
+def test_tsne_separates_blobs():
+    pts, labels = _blobs(n_per=30, seed=7)
+    emb = Tsne(perplexity=10, max_iter=250, seed=1).fit(pts)
+    assert emb.shape == (90, 2)
+    # cluster centroid distances in embedding >> intra-cluster spread
+    cents = np.stack([emb[labels == c].mean(0) for c in range(3)])
+    spread = np.mean([emb[labels == c].std() for c in range(3)])
+    min_sep = np.inf
+    for i in range(3):
+        for j in range(i + 1, 3):
+            min_sep = min(min_sep, np.linalg.norm(cents[i] - cents[j]))
+    assert min_sep > 2 * spread
+
+
+@pytest.mark.slow
+def test_barnes_hut_tsne_runs():
+    pts, _ = _blobs(n_per=20, seed=8)
+    emb = BarnesHutTsne(theta=0.5, max_iter=60, seed=1).fit(pts)
+    assert emb.shape == (60, 2)
+    assert np.isfinite(emb).all()
