@@ -1,0 +1,20 @@
+"""Graph API + embeddings (parity: reference deeplearning4j-graph/).
+
+In-memory graph structures, random-walk iterators and DeepWalk graph
+vectorization (hierarchical softmax over a degree-weighted Huffman tree),
+re-designed TPU-first: walks are generated vectorized on host, embedding
+updates run as one jit'd batched gather/scatter step on device.
+"""
+
+from deeplearning4j_tpu.graph.api import (Vertex, Edge, Graph,
+                                          NoEdgeHandling, NoEdgesException)
+from deeplearning4j_tpu.graph.walks import (RandomWalkIterator,
+                                            WeightedRandomWalkIterator,
+                                            RandomWalkGraphIteratorProvider)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphHuffman
+
+__all__ = [
+    "Vertex", "Edge", "Graph", "NoEdgeHandling", "NoEdgesException",
+    "RandomWalkIterator", "WeightedRandomWalkIterator",
+    "RandomWalkGraphIteratorProvider", "DeepWalk", "GraphHuffman",
+]

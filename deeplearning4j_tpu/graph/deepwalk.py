@@ -1,0 +1,301 @@
+"""DeepWalk graph vectorization (Perozzi et al. 2014).
+
+Parity surface: reference graph/models/deepwalk/DeepWalk.java (builder:
+vectorSize/windowSize/learningRate/seed; fit over random walks),
+deepwalk/GraphHuffman.java (degree-weighted Huffman tree for hierarchical
+softmax), models/embeddings/InMemoryGraphLookupTable.java (in/out vector
+tables + sigmoid table) and GraphVectorsImpl.java (similarity / nearest).
+
+TPU re-design: the reference trains with per-pair scalar updates across a
+thread pool. Here walks are generated vectorized on host
+(:func:`walks.generate_walks_batch`), expanded into (center, target) skip-gram
+pairs, and each batch is ONE jit'd hierarchical-softmax step on device —
+shared with Word2Vec (:func:`nlp.word2vec._sg_hs_step`), gather → sigmoid →
+scatter-add over the embedding tables.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+from functools import partial
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+import jax
+
+from deeplearning4j_tpu.graph.api import Graph, NoEdgeHandling
+from deeplearning4j_tpu.graph.walks import generate_walks_batch
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _hs_batch_step(syn0, syn1, centers, points, codes, code_mask, lr):
+    """Hierarchical-softmax step with per-index gradient averaging.
+
+    The reference applies each (center, target) pair sequentially, so a
+    vertex hit many times self-limits through the updated sigmoid. A batched
+    scatter-add instead SUMS all co-located pair gradients — on dense small
+    graphs the Huffman root collects thousands of summed updates and the
+    tables diverge. Normalizing each update by its index's occurrence count
+    in the batch restores sequential-scale steps while keeping the whole
+    batch as one fused device step."""
+    v = syn0[centers]                      # (B, D)
+    u = syn1[points]                       # (B, L, D)
+    s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
+    g = (1.0 - codes - s) * lr * code_mask
+    dv = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * v[:, None, :]
+    cnt_c = jnp.zeros((syn0.shape[0],), jnp.float32).at[centers].add(1.0)
+    dv = dv / cnt_c[centers][:, None]
+    B, L = points.shape
+    flat_p = points.reshape(-1)
+    flat_m = code_mask.reshape(-1)
+    cnt_p = jnp.zeros((syn1.shape[0],), jnp.float32).at[flat_p].add(flat_m)
+    du = du.reshape(B * L, -1) / jnp.maximum(cnt_p[flat_p], 1.0)[:, None]
+    syn0 = syn0.at[centers].add(dv)
+    syn1 = syn1.at[flat_p].add(du)
+    return syn0, syn1
+
+
+class GraphHuffman:
+    """Huffman tree over vertex degrees for hierarchical softmax
+    (parity: graph/models/deepwalk/GraphHuffman.java — codes, code lengths
+    and inner-node paths per leaf)."""
+
+    def __init__(self, n_vertices: int, max_code_length: int = 64):
+        self.n = n_vertices
+        self.max_code_length = max_code_length
+        self.codes: List[List[int]] = [[] for _ in range(n_vertices)]
+        self.points: List[List[int]] = [[] for _ in range(n_vertices)]
+
+    def build_tree(self, vertex_degrees: Sequence[int]) -> "GraphHuffman":
+        n = self.n
+        assert len(vertex_degrees) == n
+        if n == 1:
+            self.codes[0], self.points[0] = [0], [0]
+            return self
+        heap = [(int(d), i, i) for i, d in enumerate(vertex_degrees)]
+        heapq.heapify(heap)
+        children = {}
+        next_id = n
+        while len(heap) > 1:
+            c1, _, id1 = heapq.heappop(heap)
+            c2, _, id2 = heapq.heappop(heap)
+            children[next_id] = (id1, id2)
+            heapq.heappush(heap, (c1 + c2, next_id, next_id))
+            next_id += 1
+        root = heap[0][2]
+        stack = [(root, [], [])]
+        while stack:
+            node, code, path = stack.pop()
+            if len(code) > self.max_code_length:
+                raise RuntimeError(
+                    f"code length exceeds {self.max_code_length} bits")
+            if node < n:
+                self.codes[node] = code
+                # inner nodes numbered relative to leaf count, root first
+                self.points[node] = [p - n for p in path]
+                continue
+            left, right = children[node]
+            stack.append((left, code + [0], path + [node]))
+            stack.append((right, code + [1], path + [node]))
+        return self
+
+    def get_code_length(self, v: int) -> int:
+        return len(self.codes[v])
+
+    def get_code(self, v: int) -> int:
+        """Code as packed int, LSB = first branch (parity: getCode)."""
+        out = 0
+        for i, b in enumerate(self.codes[v]):
+            out |= b << i
+        return out
+
+    def get_path_inner_node(self, v: int) -> List[int]:
+        return list(self.points[v])
+
+    def padded(self):
+        """(points, codes, mask) padded (V, L) arrays for device HS steps."""
+        L = max(1, max(len(c) for c in self.codes))
+        pts = np.zeros((self.n, L), np.int32)
+        cds = np.zeros((self.n, L), np.float32)
+        msk = np.zeros((self.n, L), np.float32)
+        for v in range(self.n):
+            k = len(self.codes[v])
+            pts[v, :k] = self.points[v]
+            cds[v, :k] = self.codes[v]
+            msk[v, :k] = 1.0
+        return pts, cds, msk
+
+
+class DeepWalk:
+    """DeepWalk model (parity: graph/models/deepwalk/DeepWalk.java +
+    GraphVectorsImpl similarity/nearest API; Builder pattern kept)."""
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, seed: int = 12345,
+                 batch_size: int = 4096, walks_per_vertex: int = 1):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.batch_size = batch_size
+        self.walks_per_vertex = walks_per_vertex
+        self.syn0 = None     # (V, D) in-vectors (the embeddings)
+        self.syn1 = None     # (V-1, D) inner-node vectors
+        self._hs = None
+        self._init_called = False
+
+    # -- builder parity ----------------------------------------------------
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def vector_size(self, d):
+            self._kw["vector_size"] = d
+            return self
+
+        def window_size(self, w):
+            self._kw["window_size"] = w
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(**self._kw)
+
+    # -- init --------------------------------------------------------------
+    def initialize(self, graph_or_degrees) -> "DeepWalk":
+        """Build the Huffman tree + lookup tables (parity: initialize)."""
+        if isinstance(graph_or_degrees, Graph):
+            degrees = graph_or_degrees.degrees()
+        else:
+            degrees = np.asarray(graph_or_degrees, np.int64)
+        V = len(degrees)
+        self._hs = GraphHuffman(V).build_tree(degrees)
+        rng = np.random.default_rng(self.seed)
+        scale = 0.5 / self.vector_size
+        self.syn0 = jnp.asarray(
+            rng.uniform(-scale, scale, (V, self.vector_size)), jnp.float32)
+        self.syn1 = jnp.zeros((max(V - 1, 1), self.vector_size), jnp.float32)
+        self._pts, self._cds, self._msk = self._hs.padded()
+        self._init_called = True
+        return self
+
+    # -- training ----------------------------------------------------------
+    def fit(self, graph: Graph, walk_length: int = 40, *,
+            epochs: int = 1,
+            mode: NoEdgeHandling = NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED
+            ) -> "DeepWalk":
+        """Generate random walks over every vertex and train skip-gram HS
+        (parity: DeepWalk.fit(IGraph, walkLength) — the reference fans walks
+        across threads; here walk lanes are a vectorized batch and updates
+        are one jit'd device step per pair-batch)."""
+        if not self._init_called:
+            self.initialize(graph)
+        rng = np.random.default_rng(self.seed)
+        V = graph.num_vertices()
+        lr = self.learning_rate
+        for _ in range(epochs):
+            for _ in range(self.walks_per_vertex):
+                starts = rng.permutation(V)
+                for ofs in range(0, V, 1024):
+                    walks = generate_walks_batch(
+                        graph, starts[ofs:ofs + 1024], walk_length, rng,
+                        mode=mode)
+                    self._train_walks(walks, lr, rng)
+        return self
+
+    def fit_walks(self, walks: np.ndarray,
+                  lr: Optional[float] = None) -> "DeepWalk":
+        """Train directly on pre-generated (B, T) walks (parity:
+        fit(GraphWalkIteratorProvider) — bring-your-own walk source)."""
+        if not self._init_called:
+            raise RuntimeError("DeepWalk not initialized (call initialize)")
+        self._train_walks(np.asarray(walks, np.int32),
+                          self.learning_rate if lr is None else lr,
+                          np.random.default_rng(self.seed))
+        return self
+
+    def _train_walks(self, walks: np.ndarray, lr: float,
+                     rng: np.random.Generator) -> None:
+        B, T = walks.shape
+        win = self.window_size
+        centers, targets = [], []
+        for i in range(T):
+            lo, hi = max(0, i - win), min(T, i + win + 1)
+            for j in range(lo, hi):
+                if j == i:
+                    continue
+                centers.append(walks[:, i])
+                targets.append(walks[:, j])
+        centers = np.concatenate(centers)
+        targets = np.concatenate(targets)
+        order = rng.permutation(len(centers))
+        centers, targets = centers[order], targets[order]
+        bs = self.batch_size
+        for ofs in range(0, len(centers), bs):
+            c = jnp.asarray(centers[ofs:ofs + bs])
+            t = targets[ofs:ofs + bs]
+            self.syn0, self.syn1 = _hs_batch_step(
+                self.syn0, self.syn1, c,
+                jnp.asarray(self._pts[t]), jnp.asarray(self._cds[t]),
+                jnp.asarray(self._msk[t]), jnp.float32(lr))
+
+    # -- GraphVectors API --------------------------------------------------
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return np.asarray(self.syn0[v])
+
+    def num_vertices(self) -> int:
+        return int(self.syn0.shape[0])
+
+    def similarity(self, v1: int, v2: int) -> float:
+        """Cosine similarity (parity: GraphVectorsImpl.similarity)."""
+        a, b = np.asarray(self.syn0[v1]), np.asarray(self.syn0[v2])
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def vertices_nearest(self, v: int, top: int = 5) -> List[int]:
+        e = np.asarray(self.syn0)
+        q = e[v] / (np.linalg.norm(e[v]) + 1e-12)
+        sims = (e / (np.linalg.norm(e, axis=1, keepdims=True) + 1e-12)) @ q
+        sims[v] = -np.inf
+        return list(np.argsort(-sims)[:top])
+
+    # -- persistence (parity: models/loader/GraphVectorSerializer) ---------
+    def save(self, path: str) -> None:
+        np.savez(path if path.endswith(".npz") else path + ".npz",
+                 syn0=np.asarray(self.syn0), syn1=np.asarray(self.syn1),
+                 pts=self._pts, cds=self._cds, msk=self._msk,
+                 meta=json.dumps({"vector_size": self.vector_size,
+                                  "window_size": self.window_size,
+                                  "learning_rate": self.learning_rate,
+                                  "seed": self.seed,
+                                  "batch_size": self.batch_size,
+                                  "walks_per_vertex": self.walks_per_vertex}))
+
+    @staticmethod
+    def load(path: str) -> "DeepWalk":
+        if not os.path.exists(path) and os.path.exists(path + ".npz"):
+            path = path + ".npz"
+        z = np.load(path, allow_pickle=False)
+        meta = json.loads(str(z["meta"]))
+        dw = DeepWalk(vector_size=meta["vector_size"],
+                      window_size=meta["window_size"],
+                      learning_rate=meta["learning_rate"],
+                      seed=meta.get("seed", 12345),
+                      batch_size=meta.get("batch_size", 4096),
+                      walks_per_vertex=meta.get("walks_per_vertex", 1))
+        dw.syn0 = jnp.asarray(z["syn0"])
+        dw.syn1 = jnp.asarray(z["syn1"])
+        dw._pts, dw._cds, dw._msk = z["pts"], z["cds"], z["msk"]
+        dw._init_called = True
+        return dw

@@ -217,6 +217,7 @@ class MultiLayerConfiguration:
         it = self.input_type
         for l in self.layers:
             l.apply_defaults(defaults)
+            l.validate()
             if it is not None:
                 l.set_n_in(it)
                 it = l.output_type(it)

@@ -259,6 +259,7 @@ class ComputationGraphConfiguration:
             in_types = [types.get(i) for i in node.inputs]
             if node.kind == "layer":
                 node.layer.apply_defaults(defaults)
+                node.layer.validate()
                 if in_types and in_types[0] is not None:
                     node.layer.set_n_in(in_types[0])
                     types[name] = node.layer.output_type(in_types[0])

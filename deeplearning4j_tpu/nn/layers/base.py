@@ -63,6 +63,16 @@ class Layer:
             if hasattr(self, f) and getattr(self, f) is None and f in defaults:
                 setattr(self, f, defaults[f])
 
+    def validate(self) -> None:
+        """Fail fast on unknown activation/loss names at config-build time
+        (parity: the reference's enums make these unrepresentable)."""
+        from deeplearning4j_tpu.nn.activations import get_activation
+        if getattr(self, "activation", None) is not None:
+            get_activation(self.activation)
+        if getattr(self, "loss", None) is not None:
+            from deeplearning4j_tpu.nn.losses import get_loss
+            get_loss(self.loss)
+
     def set_n_in(self, input_type: InputType) -> None:
         pass
 

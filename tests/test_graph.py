@@ -1,0 +1,155 @@
+"""Graph API + DeepWalk tests (model: reference deeplearning4j-graph/src/test
+— TestGraph.java, TestGraphHuffman.java, DeepWalkGradientCheck/TestDeepWalk)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (Graph, NoEdgeHandling, NoEdgesException,
+                                      RandomWalkIterator,
+                                      WeightedRandomWalkIterator,
+                                      RandomWalkGraphIteratorProvider,
+                                      DeepWalk, GraphHuffman)
+from deeplearning4j_tpu.graph.walks import generate_walks_batch
+
+
+def _ring(n=10):
+    g = Graph(n)
+    for i in range(n):
+        g.add_edge(i, (i + 1) % n)
+    return g
+
+
+def test_graph_structure():
+    g = _ring(10)
+    assert g.num_vertices() == 10
+    assert g.get_vertex_degree(0) == 2
+    assert sorted(g.neighbors(0)) == [1, 9]
+    g2 = Graph(3)
+    g2.add_edge(0, 1, directed=True)
+    assert g2.neighbors(0) == [1] and g2.neighbors(1) == []
+
+
+def test_random_walks_stay_on_edges():
+    g = _ring(10)
+    it = RandomWalkIterator(g, walk_length=8, seed=0)
+    walks = list(it)
+    assert len(walks) == 10
+    for w in walks:
+        assert len(w) == 9
+        for a, b in zip(w, w[1:]):
+            assert b in g.neighbors(a)
+    # starts cover every vertex in order
+    assert [w[0] for w in walks] == list(range(10))
+
+
+def test_disconnected_vertex_handling():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    it = RandomWalkIterator(g, walk_length=3, seed=0,
+                            mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+                            first_vertex=2, last_vertex=3)
+    assert next(it) == [2, 2, 2, 2]
+    it2 = RandomWalkIterator(g, walk_length=3, seed=0,
+                             mode=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED,
+                             first_vertex=2, last_vertex=3)
+    with pytest.raises(NoEdgesException):
+        next(it2)
+
+
+def test_weighted_walks_follow_weights():
+    g = Graph(3)
+    g.add_edge(0, 1, weight=1e6, directed=True)
+    g.add_edge(0, 2, weight=1e-6, directed=True)
+    g.add_edge(1, 0, weight=1.0, directed=True)
+    g.add_edge(2, 0, weight=1.0, directed=True)
+    it = WeightedRandomWalkIterator(g, walk_length=1, seed=1)
+    firsts = [next(it)[1]]
+    for _ in range(20):
+        it.reset()
+        firsts.append(next(it)[1])
+    assert all(f == 1 for f in firsts)  # ~never picks the 1e-12-prob edge
+
+
+def test_iterator_provider_partitions():
+    g = _ring(10)
+    its = RandomWalkGraphIteratorProvider(g, 4).get_graph_walk_iterators(3)
+    starts = [w[0] for it in its for w in it]
+    assert sorted(starts) == list(range(10))
+
+
+def test_vectorized_walks_match_graph():
+    g = _ring(12)
+    rng = np.random.default_rng(0)
+    walks = generate_walks_batch(g, np.arange(12), 6, rng)
+    assert walks.shape == (12, 7)
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert int(b) in g.neighbors(int(a))
+
+
+def test_graph_huffman_codes():
+    # model: reference TestGraphHuffman.java — 7 vertices with known degrees
+    hs = GraphHuffman(7).build_tree([12, 3, 6, 1, 2, 7, 8])
+    lens = [hs.get_code_length(v) for v in range(7)]
+    # highest-degree vertex gets shortest code; codes are prefix-free
+    assert lens[0] == min(lens)
+    assert lens[3] == max(lens)
+    codes = {(hs.get_code(v), hs.get_code_length(v)) for v in range(7)}
+    assert len(codes) == 7
+    for v in range(7):
+        assert len(hs.get_path_inner_node(v)) == hs.get_code_length(v)
+        assert all(0 <= p < 6 for p in hs.get_path_inner_node(v))
+
+
+def test_deepwalk_learns_community_structure():
+    # two dense cliques joined by one bridge edge: embeddings should place
+    # same-clique vertices nearer than cross-clique ones.
+    n = 12
+    g = Graph(n)
+    for grp in (range(0, 6), range(6, 12)):
+        grp = list(grp)
+        for i in grp:
+            for j in grp:
+                if i < j:
+                    g.add_edge(i, j)
+    g.add_edge(5, 6)
+    dw = (DeepWalk.Builder().vector_size(16).window_size(3)
+          .learning_rate(0.1).seed(7).build())
+    dw.walks_per_vertex = 5
+    dw.fit(g, walk_length=10, epochs=20)
+    same = np.mean([dw.similarity(0, j) for j in range(1, 6)])
+    cross = np.mean([dw.similarity(0, j) for j in range(6, 12)])
+    assert same > cross
+
+
+def test_deepwalk_save_load_roundtrip(tmp_path):
+    g = _ring(8)
+    dw = DeepWalk(vector_size=8, seed=3).initialize(g)
+    dw.fit(g, walk_length=5)
+    p = str(tmp_path / "dw")
+    dw.save(p)
+    dw2 = DeepWalk.load(p)
+    np.testing.assert_allclose(dw2.get_vertex_vector(2),
+                               dw.get_vertex_vector(2), rtol=1e-6)
+    assert dw2.num_vertices() == 8
+    # training continues after load (HS tables restored)
+    dw2.fit(g, walk_length=5)
+    dw2.fit_walks(np.array([[0, 1, 2, 3]], np.int32))
+
+
+def test_batch_walks_exception_mode():
+    g = Graph(3)
+    g.add_edge(0, 1, directed=True)  # vertex 1,2 have no out-edges... 1 has none
+    rng = np.random.default_rng(0)
+    with pytest.raises(NoEdgesException):
+        generate_walks_batch(g, np.array([0]), 3, rng,
+                             mode=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+
+
+def test_edge_list_loader(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment\n0,1\n1,2,5.0\n2,0\n")
+    from deeplearning4j_tpu.graph.api import load_edge_list
+    g = load_edge_list(str(p), 3, weighted=True)
+    assert g.get_vertex_degree(0) == 2
+    assert 5.0 in g.neighbor_weights(1)
