@@ -19,45 +19,14 @@ from __future__ import annotations
 import heapq
 import json
 import os
-from functools import partial
 from typing import List, Optional, Sequence
 
 import numpy as np
 import jax.numpy as jnp
 
-import jax
-
 from deeplearning4j_tpu.graph.api import Graph, NoEdgeHandling
 from deeplearning4j_tpu.graph.walks import generate_walks_batch
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _hs_batch_step(syn0, syn1, centers, points, codes, code_mask, lr):
-    """Hierarchical-softmax step with per-index gradient averaging.
-
-    The reference applies each (center, target) pair sequentially, so a
-    vertex hit many times self-limits through the updated sigmoid. A batched
-    scatter-add instead SUMS all co-located pair gradients — on dense small
-    graphs the Huffman root collects thousands of summed updates and the
-    tables diverge. Normalizing each update by its index's occurrence count
-    in the batch restores sequential-scale steps while keeping the whole
-    batch as one fused device step."""
-    v = syn0[centers]                      # (B, D)
-    u = syn1[points]                       # (B, L, D)
-    s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
-    g = (1.0 - codes - s) * lr * code_mask
-    dv = jnp.einsum("bl,bld->bd", g, u)
-    du = g[..., None] * v[:, None, :]
-    cnt_c = jnp.zeros((syn0.shape[0],), jnp.float32).at[centers].add(1.0)
-    dv = dv / cnt_c[centers][:, None]
-    B, L = points.shape
-    flat_p = points.reshape(-1)
-    flat_m = code_mask.reshape(-1)
-    cnt_p = jnp.zeros((syn1.shape[0],), jnp.float32).at[flat_p].add(flat_m)
-    du = du.reshape(B * L, -1) / jnp.maximum(cnt_p[flat_p], 1.0)[:, None]
-    syn0 = syn0.at[centers].add(dv)
-    syn1 = syn1.at[flat_p].add(du)
-    return syn0, syn1
+from deeplearning4j_tpu.nlp.word2vec import _sg_hs_step
 
 
 class GraphHuffman:
@@ -246,10 +215,10 @@ class DeepWalk:
         for ofs in range(0, len(centers), bs):
             c = jnp.asarray(centers[ofs:ofs + bs])
             t = targets[ofs:ofs + bs]
-            self.syn0, self.syn1 = _hs_batch_step(
+            self.syn0, self.syn1 = _sg_hs_step(
                 self.syn0, self.syn1, c,
                 jnp.asarray(self._pts[t]), jnp.asarray(self._cds[t]),
-                jnp.asarray(self._msk[t]), jnp.float32(lr))
+                jnp.asarray(self._msk[t]), jnp.float32(lr), normalize=True)
 
     # -- GraphVectors API --------------------------------------------------
     def get_vertex_vector(self, v: int) -> np.ndarray:

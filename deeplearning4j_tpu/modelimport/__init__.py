@@ -1,0 +1,23 @@
+"""Keras HDF5 model import (parity: reference deeplearning4j-modelimport/).
+
+Imports Keras 1.x / 2.x models saved with ``model.save()`` (config + weights
+in one HDF5) or config-JSON + weights-HDF5 pairs, into
+:class:`MultiLayerNetwork` (Sequential) or :class:`ComputationGraph`
+(functional Model).
+"""
+
+from deeplearning4j_tpu.modelimport.keras_import import (
+    KerasModelImport,
+    import_keras_sequential_model_and_weights,
+    import_keras_model_and_weights,
+    InvalidKerasConfigurationException,
+    UnsupportedKerasConfigurationException,
+)
+
+__all__ = [
+    "KerasModelImport",
+    "import_keras_sequential_model_and_weights",
+    "import_keras_model_and_weights",
+    "InvalidKerasConfigurationException",
+    "UnsupportedKerasConfigurationException",
+]

@@ -76,11 +76,19 @@ def _sg_infer_step(dv, syn1neg, table, docs, words, lr, key, negative):
     return dv.at[docs].add(delta)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
+@partial(jax.jit, static_argnames=("normalize",), donate_argnums=(0, 1))
+def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr, *,
+                normalize=False):
     """Skip-gram hierarchical-softmax batch.
     points/codes/code_mask: (B, L) padded Huffman paths of the CONTEXT word;
-    centers: (B,) input word indices."""
+    centers: (B,) input word indices.
+
+    ``normalize=True`` divides each scatter-add by the index's occurrence
+    count in the batch. The reference applies pairs sequentially, so a
+    vertex/word hit many times self-limits through the updated sigmoid;
+    a batched scatter-add SUMS co-located gradients instead — on dense
+    small graphs (DeepWalk's regime) the Huffman root collects thousands of
+    summed updates and the tables diverge without this."""
     v = syn0[centers]                      # (B, D)
     u = syn1[points]                       # (B, L, D)
     s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
@@ -88,9 +96,17 @@ def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr):
     g = (1.0 - codes - s) * lr * code_mask
     dv = jnp.einsum("bl,bld->bd", g, u)
     du = g[..., None] * v[:, None, :]
-    syn0 = syn0.at[centers].add(dv)
     B, L = points.shape
-    syn1 = syn1.at[points.reshape(-1)].add(du.reshape(B * L, -1))
+    flat_p = points.reshape(-1)
+    du = du.reshape(B * L, -1)
+    if normalize:
+        cnt_c = jnp.zeros((syn0.shape[0],), jnp.float32).at[centers].add(1.0)
+        dv = dv / cnt_c[centers][:, None]
+        cnt_p = jnp.zeros((syn1.shape[0],), jnp.float32).at[flat_p].add(
+            code_mask.reshape(-1))
+        du = du / jnp.maximum(cnt_p[flat_p], 1.0)[:, None]
+    syn0 = syn0.at[centers].add(dv)
+    syn1 = syn1.at[flat_p].add(du)
     return syn0, syn1
 
 

@@ -266,6 +266,7 @@ class ComputationGraphConfiguration:
             else:
                 if all(t is not None for t in in_types) and in_types:
                     types[name] = node.vertex.output_type(in_types)
+        self.node_output_types = types
         return self
 
     # serde ----------------------------------------------------------------

@@ -1,0 +1,273 @@
+"""Keras HDF5 import tests (model: reference deeplearning4j-modelimport/
+src/test — e2e imports against bundled Keras HDF5 resources; here fixtures
+are written in-test with h5py in the exact Keras 2 save format)."""
+
+import json
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from deeplearning4j_tpu.modelimport import (
+    KerasModelImport, import_keras_sequential_model_and_weights,
+    import_keras_model_and_weights, InvalidKerasConfigurationException,
+    UnsupportedKerasConfigurationException)
+
+
+def _write_keras_h5(path, model_cfg, weights, training_cfg=None):
+    """weights: {layer_name: [(weight_name, array), ...]}"""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_cfg).encode()
+        if training_cfg is not None:
+            f.attrs["training_config"] = json.dumps(training_cfg).encode()
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [k.encode() for k in weights], dtype="S64")
+        for lname, ws in weights.items():
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [wn.encode() for wn, _ in ws], dtype="S128")
+            for wn, arr in ws:
+                g.create_dataset(wn, data=arr)
+
+
+def _seq_cfg(layers):
+    return {"class_name": "Sequential", "config": {"layers": layers},
+            "keras_version": "2.2.4", "backend": "tensorflow"}
+
+
+def test_sequential_mlp_import(tmp_path):
+    rng = np.random.default_rng(0)
+    W1, b1 = rng.normal(size=(4, 8)).astype("f4"), rng.normal(size=(8,)).astype("f4")
+    W2, b2 = rng.normal(size=(8, 3)).astype("f4"), rng.normal(size=(3,)).astype("f4")
+    cfg = _seq_cfg([
+        {"class_name": "Dense", "config": {
+            "name": "d1", "units": 8, "activation": "relu",
+            "use_bias": True, "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "d2", "units": 3, "activation": "softmax", "use_bias": True}},
+    ])
+    p = str(tmp_path / "mlp.h5")
+    _write_keras_h5(p, cfg, {
+        "d1": [("d1/kernel:0", W1), ("d1/bias:0", b1)],
+        "d2": [("d2/kernel:0", W2), ("d2/bias:0", b2)],
+    }, training_cfg={"loss": "categorical_crossentropy", "optimizer_config": {}})
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(5, 4)).astype("f4")
+    got = np.asarray(net.output(x))
+    h = np.maximum(x @ W1 + b1, 0.0)
+    z = h @ W2 + b2
+    want = np.exp(z - z.max(-1, keepdims=True))
+    want /= want.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # loss attached -> trainable output layer
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    assert isinstance(net.layers[-1], OutputLayer)
+    assert net.layers[-1].loss == "mcxent"
+
+
+def test_sequential_convnet_import(tmp_path):
+    rng = np.random.default_rng(1)
+    K = rng.normal(size=(3, 3, 2, 4), scale=0.5).astype("f4")
+    bK = rng.normal(size=(4,)).astype("f4")
+    Wd = rng.normal(size=(4 * 4 * 4, 5), scale=0.2).astype("f4")
+    bd = rng.normal(size=(5,)).astype("f4")
+    cfg = _seq_cfg([
+        {"class_name": "Conv2D", "config": {
+            "name": "c1", "filters": 4, "kernel_size": [3, 3],
+            "strides": [1, 1], "padding": "same", "activation": "relu",
+            "use_bias": True, "data_format": "channels_last",
+            "batch_input_shape": [None, 8, 8, 2]}},
+        {"class_name": "MaxPooling2D", "config": {
+            "name": "p1", "pool_size": [2, 2], "strides": [2, 2],
+            "padding": "valid"}},
+        {"class_name": "Flatten", "config": {"name": "fl"}},
+        {"class_name": "Dense", "config": {
+            "name": "d1", "units": 5, "activation": "linear",
+            "use_bias": True}},
+    ])
+    p = str(tmp_path / "cnn.h5")
+    _write_keras_h5(p, cfg, {
+        "c1": [("c1/kernel:0", K), ("c1/bias:0", bK)],
+        "d1": [("d1/kernel:0", Wd), ("d1/bias:0", bd)],
+    })
+    net = KerasModelImport.import_keras_model(p)
+    # compare against the same net built natively with the same weights
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                              SubsamplingLayer, DenseLayer)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    ref = NeuralNetConfiguration.builder().list() \
+        .layer(ConvolutionLayer(n_out=4, kernel_size=3, stride=1,
+                                convolution_mode="same", activation="relu")) \
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=2, stride=2)) \
+        .layer(DenseLayer(n_out=5, activation="identity")) \
+        .set_input_type(InputType.convolutional(8, 8, 2)).build()
+    from deeplearning4j_tpu import MultiLayerNetwork
+    refnet = MultiLayerNetwork(ref).init()
+    refnet.params[0]["W"] = jnp.asarray(K)
+    refnet.params[0]["b"] = jnp.asarray(bK)
+    refnet.params[2]["W"] = jnp.asarray(Wd)
+    refnet.params[2]["b"] = jnp.asarray(bd)
+    x = rng.normal(size=(3, 8, 8, 2)).astype("f4")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(refnet.output(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_gate_reorder(tmp_path):
+    rng = np.random.default_rng(2)
+    I, H, T, B = 3, 4, 6, 2
+    K = rng.normal(size=(I, 4 * H), scale=0.3).astype("f4")
+    R = rng.normal(size=(H, 4 * H), scale=0.3).astype("f4")
+    b = rng.normal(size=(4 * H,), scale=0.1).astype("f4")
+    cfg = _seq_cfg([
+        {"class_name": "LSTM", "config": {
+            "name": "l1", "units": H, "activation": "tanh",
+            "recurrent_activation": "sigmoid", "use_bias": True,
+            "return_sequences": True, "batch_input_shape": [None, T, I]}},
+    ])
+    p = str(tmp_path / "lstm.h5")
+    _write_keras_h5(p, cfg, {
+        "l1": [("l1/kernel:0", K), ("l1/recurrent_kernel:0", R),
+               ("l1/bias:0", b)],
+    })
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(B, T, I)).astype("f4")
+    got = np.asarray(net.output(x))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    h = np.zeros((B, H), "f4")
+    c = np.zeros((B, H), "f4")
+    want = []
+    for t in range(T):
+        z = x[:, t] @ K + h @ R + b
+        i = sig(z[:, 0:H])
+        f = sig(z[:, H:2 * H])
+        g = np.tanh(z[:, 2 * H:3 * H])
+        o = sig(z[:, 3 * H:4 * H])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        want.append(h.copy())
+    want = np.stack(want, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_return_sequences_false(tmp_path):
+    """Keras default return_sequences=False must import as last-step output."""
+    rng = np.random.default_rng(5)
+    I, H, T = 3, 4, 5
+    K = rng.normal(size=(I, 4 * H), scale=0.3).astype("f4")
+    R = rng.normal(size=(H, 4 * H), scale=0.3).astype("f4")
+    b = np.zeros(4 * H, "f4")
+    Wd = rng.normal(size=(H, 2), scale=0.5).astype("f4")
+    cfg = _seq_cfg([
+        {"class_name": "LSTM", "config": {
+            "name": "l1", "units": H, "activation": "tanh",
+            "recurrent_activation": "sigmoid", "use_bias": True,
+            "batch_input_shape": [None, T, I]}},
+        {"class_name": "Dense", "config": {
+            "name": "d1", "units": 2, "activation": "linear",
+            "use_bias": False}},
+    ])
+    p = str(tmp_path / "lstm_cls.h5")
+    _write_keras_h5(p, cfg, {
+        "l1": [("l1/kernel:0", K), ("l1/recurrent_kernel:0", R),
+               ("l1/bias:0", b)],
+        "d1": [("d1/kernel:0", Wd)],
+    })
+    net = import_keras_sequential_model_and_weights(p)
+    x = rng.normal(size=(2, T, I)).astype("f4")
+    got = np.asarray(net.output(x))
+    assert got.shape == (2, 2)   # (B, k), not (B, T, k)
+
+
+def test_batchnorm_running_stats(tmp_path):
+    gamma = np.array([1.5, 0.5], "f4")
+    beta = np.array([0.1, -0.2], "f4")
+    mean = np.array([0.3, -0.4], "f4")
+    var = np.array([2.0, 0.5], "f4")
+    cfg = _seq_cfg([
+        {"class_name": "Dense", "config": {
+            "name": "d1", "units": 2, "activation": "linear",
+            "use_bias": False, "batch_input_shape": [None, 2]}},
+        {"class_name": "BatchNormalization", "config": {
+            "name": "bn", "epsilon": 1e-3, "momentum": 0.99}},
+    ])
+    W = np.eye(2, dtype="f4")
+    p = str(tmp_path / "bn.h5")
+    _write_keras_h5(p, cfg, {
+        "d1": [("d1/kernel:0", W)],
+        "bn": [("bn/gamma:0", gamma), ("bn/beta:0", beta),
+               ("bn/moving_mean:0", mean), ("bn/moving_variance:0", var)],
+    })
+    net = import_keras_sequential_model_and_weights(p)
+    x = np.array([[1.0, 1.0], [0.0, 2.0]], "f4")
+    got = np.asarray(net.output(x))   # inference mode -> running stats
+    want = gamma * (x - mean) / np.sqrt(var + 1e-3) + beta
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_model_with_add(tmp_path):
+    rng = np.random.default_rng(3)
+    W1 = rng.normal(size=(4, 4)).astype("f4")
+    W2 = rng.normal(size=(4, 4)).astype("f4")
+    Wo = rng.normal(size=(4, 2)).astype("f4")
+    cfg = {
+        "class_name": "Model",
+        "config": {
+            "name": "m",
+            "layers": [
+                {"class_name": "InputLayer", "name": "in",
+                 "config": {"name": "in", "batch_input_shape": [None, 4]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "a",
+                 "config": {"name": "a", "units": 4, "activation": "relu",
+                            "use_bias": False},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "b",
+                 "config": {"name": "b", "units": 4, "activation": "relu",
+                            "use_bias": False},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Add", "name": "add",
+                 "config": {"name": "add"},
+                 "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "units": 2,
+                            "activation": "linear", "use_bias": False},
+                 "inbound_nodes": [[["add", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        },
+        "keras_version": "2.2.4", "backend": "tensorflow",
+    }
+    p = str(tmp_path / "fn.h5")
+    _write_keras_h5(p, cfg, {
+        "a": [("a/kernel:0", W1)],
+        "b": [("b/kernel:0", W2)],
+        "out": [("out/kernel:0", Wo)],
+    })
+    net = import_keras_model_and_weights(p)
+    x = rng.normal(size=(3, 4)).astype("f4")
+    got = np.asarray(net.output(x))
+    want = (np.maximum(x @ W1, 0) + np.maximum(x @ W2, 0)) @ Wo
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_errors(tmp_path):
+    p = str(tmp_path / "bad.h5")
+    with h5py.File(p, "w") as f:
+        f.create_dataset("x", data=np.zeros(3))
+    with pytest.raises(InvalidKerasConfigurationException):
+        import_keras_sequential_model_and_weights(p)
+    cfg = _seq_cfg([{"class_name": "Reshape", "config": {
+        "name": "r", "target_shape": [2, 2],
+        "batch_input_shape": [None, 4]}}])
+    p2 = str(tmp_path / "unsup.h5")
+    _write_keras_h5(p2, cfg, {})
+    with pytest.raises(UnsupportedKerasConfigurationException):
+        import_keras_sequential_model_and_weights(p2)
