@@ -25,6 +25,19 @@ def scaled_dot_product_attention(q, k, v, *, causal=False, mask=None,
                                  q_offset=0, k_offset=0):
     """q/k/v: (B, T, H, Dh). mask: (B, Tk) key padding mask. Offsets give
     global positions for causal masking of sequence blocks."""
+    from deeplearning4j_tpu import ops
+    if (mask is None and q_offset == 0 and k_offset == 0
+            and q.shape == k.shape and v.shape == q.shape
+            and ops.helpers_enabled()):
+        from deeplearning4j_tpu.ops.flash_attention import supported
+        if supported(q.shape[1], q.shape[-1]):
+            B, T, H, Dh = q.shape
+            dt = q.dtype
+            fold = lambda a: (a.transpose(0, 2, 1, 3)
+                              .reshape(B * H, T, Dh).astype(jnp.float32))
+            o = ops.flash_attention(fold(q), fold(k), fold(v), causal,
+                                    ops.interpret_mode())
+            return (o.reshape(B, H, T, Dh).transpose(0, 2, 1, 3).astype(dt))
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:

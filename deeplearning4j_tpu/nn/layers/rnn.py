@@ -81,10 +81,29 @@ class LSTM(Layer):
             c_new = m * c_new + (1 - m) * c
         return h_new, c_new
 
+    def _fused_supported(self, mask):
+        """cuDNN-parity support check (CudnnLSTMHelper supports plain LSTM,
+        sigmoid gates, tanh cell, no masking; everything else falls back to
+        the built-in path)."""
+        from deeplearning4j_tpu import ops
+        return (ops.helpers_enabled() and mask is None
+                and type(self) is LSTM
+                and self.gate_activation == "sigmoid"
+                and (self.activation or "tanh") == "tanh")
+
     def _scan(self, params, x, mask, h0, c0):
         B, T, _ = x.shape
         gate_in = x.reshape(B * T, -1) @ params["W"] + params["b"]
         gate_in = gate_in.reshape(B, T, -1).transpose(1, 0, 2)  # (T, B, 4H)
+        if self._fused_supported(mask):
+            from deeplearning4j_tpu import ops
+            dt = x.dtype
+            hs, cs = ops.fused_lstm_sequence(
+                gate_in.astype(jnp.float32), params["RW"].astype(jnp.float32),
+                h0.astype(jnp.float32), c0.astype(jnp.float32),
+                ops.interpret_mode())
+            return (hs.transpose(1, 0, 2).astype(dt),
+                    (hs[-1].astype(dt), cs[-1].astype(dt)))
         mask_t = None if mask is None else mask.transpose(1, 0)
 
         def step(carry, inp):

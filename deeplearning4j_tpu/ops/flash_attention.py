@@ -1,0 +1,214 @@
+"""Flash attention (Pallas TPU): blocked online-softmax attention.
+
+The reference has no attention at all (SURVEY.md §5 — recurrent nets only);
+this kernel backs the TPU-first MultiHeadAttention extension
+(nn/layers/attention.py) and the ring-attention sequence-parallel path.
+O(T) memory instead of the O(T^2) scores matrix: the softmax is computed
+online per key block, carrying the running max/denominator in registers,
+and the backward pass recomputes scores blockwise from saved (o, lse).
+
+Supported: no key-padding mask (fall back to the reference path), head_dim
+and sequence length divisible by the block size. f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _pick_block(t):
+    for b in (128, 64, 32, 16, 8):
+        if t % b == 0:
+            return b
+    return None
+
+
+def supported(t, dh):
+    # K and V are held fully in VMEM per (batch*head) row; screen out
+    # shapes whose K/V exceed a conservative VMEM budget, and unaligned
+    # head dims, so the seam's silent-fallback promise holds on real TPUs.
+    return (_pick_block(t) is not None and dh % 8 == 0
+            and t * dh * 4 <= 4 * 1024 * 1024)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk, t_total, causal,
+                scale):
+    iq = pl.program_id(1)
+    q = q_ref[0]                                    # (blk, Dh)
+    num_kb = t_total // blk
+    upper = jnp.where(causal, iq + 1, num_kb)
+
+    qpos = iq * blk + lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * blk, blk), :]       # (blk, Dh)
+        vb = v_ref[0, pl.ds(j * blk, blk), :]
+        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = j * blk + lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, vb, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((blk, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((blk, 1), jnp.float32)
+    a0 = jnp.zeros((blk, q.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, a0))
+    o_ref[0] = acc / l
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, blk, t_total, causal, scale):
+    iq = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    num_kb = t_total // blk
+    upper = jnp.where(causal, iq + 1, num_kb)
+    qpos = iq * blk + lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * blk, blk), :]
+        vb = v_ref[0, pl.ds(j * blk, blk), :]
+        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = j * blk + lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros_like(q)
+    dq_ref[0] = lax.fori_loop(0, upper, body, dq0)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, blk, t_total, causal, scale):
+    jk = pl.program_id(1)
+    kb = k_ref[0]
+    vb = v_ref[0]
+    num_qb = t_total // blk
+    lower = jnp.where(causal, jk, 0)
+    kpos = jk * blk + lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * blk, blk), :]
+        dob = do_ref[0, pl.ds(i * blk, blk), :]
+        lse = lse_ref[0, pl.ds(i * blk, blk), :]
+        delta = delta_ref[0, pl.ds(i * blk, blk), :]
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = i * blk + lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse)
+        dv = dv + lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros_like(kb)
+    dk, dv = lax.fori_loop(lower, num_qb, body, (z, jnp.zeros_like(vb)))
+    dk_ref[0] = dk
+    dv_ref[0] = dv
+
+
+def _specs(bh, t, dh, blk):
+    qblk = pl.BlockSpec((1, blk, dh), lambda b, i: (b, i, 0),
+                        memory_space=pltpu.VMEM)
+    full = pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0),
+                        memory_space=pltpu.VMEM)
+    vec_blk = pl.BlockSpec((1, blk, 1), lambda b, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    vec_full = pl.BlockSpec((1, t, 1), lambda b, i: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    return qblk, full, vec_blk, vec_full
+
+
+def _fa_fwd_call(q, k, v, causal, interpret):
+    bh, t, dh = q.shape
+    blk = _pick_block(t)
+    scale = 1.0 / (dh ** 0.5)
+    qblk, full, vec_blk, _ = _specs(bh, t, dh, blk)
+    kern = functools.partial(_fwd_kernel, blk=blk, t_total=t, causal=causal,
+                             scale=scale)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bh, t // blk),
+        in_specs=[qblk, full, full],
+        out_specs=(qblk, vec_blk),
+        out_shape=(jax.ShapeDtypeStruct((bh, t, dh), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, interpret=False):
+    """q/k/v: (BH, T, Dh) float32. Returns (BH, T, Dh)."""
+    o, _ = _fa_fwd_call(q, k, v, causal, interpret)
+    return o
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    o, lse = _fa_fwd_call(q, k, v, causal, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _fa_bwd(causal, interpret, res, do):
+    q, k, v, o, lse = res
+    bh, t, dh = q.shape
+    blk = _pick_block(t)
+    scale = 1.0 / (dh ** 0.5)
+    delta = (do * o).sum(axis=-1)[..., None]         # (BH, T, 1)
+    qblk, full, vec_blk, vec_full = _specs(bh, t, dh, blk)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, blk=blk, t_total=t, causal=causal,
+                          scale=scale),
+        grid=(bh, t // blk),
+        in_specs=[qblk, full, full, qblk, vec_blk, vec_blk],
+        out_specs=qblk,
+        out_shape=jax.ShapeDtypeStruct((bh, t, dh), jnp.float32),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, blk=blk, t_total=t, causal=causal,
+                          scale=scale),
+        grid=(bh, t // blk),
+        in_specs=[full, qblk, qblk, full, vec_full, vec_full],
+        out_specs=(qblk, qblk),
+        out_shape=(jax.ShapeDtypeStruct((bh, t, dh), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, t, dh), jnp.float32)),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
