@@ -81,21 +81,24 @@ class LSTM(Layer):
             c_new = m * c_new + (1 - m) * c
         return h_new, c_new
 
-    def _fused_supported(self, mask):
+    def _fused_supported(self, mask, b, t):
         """cuDNN-parity support check (CudnnLSTMHelper supports plain LSTM,
         sigmoid gates, tanh cell, no masking; everything else falls back to
-        the built-in path)."""
+        the built-in path). Shapes are screened too so the compiled kernel
+        never sees tiles Mosaic can't lay out."""
         from deeplearning4j_tpu import ops
+        from deeplearning4j_tpu.ops.lstm_pallas import supported
         return (ops.helpers_enabled() and mask is None
                 and type(self) is LSTM
                 and self.gate_activation == "sigmoid"
-                and (self.activation or "tanh") == "tanh")
+                and (self.activation or "tanh") == "tanh"
+                and supported(b, t, self.n_out, ops.interpret_mode()))
 
     def _scan(self, params, x, mask, h0, c0):
         B, T, _ = x.shape
         gate_in = x.reshape(B * T, -1) @ params["W"] + params["b"]
         gate_in = gate_in.reshape(B, T, -1).transpose(1, 0, 2)  # (T, B, 4H)
-        if self._fused_supported(mask):
+        if self._fused_supported(mask, B, T):
             from deeplearning4j_tpu import ops
             dt = x.dtype
             hs, cs = ops.fused_lstm_sequence(

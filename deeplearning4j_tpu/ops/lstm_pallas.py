@@ -39,6 +39,45 @@ def _sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
+def supported(b, t, h, interpret=False):
+    """Shape screen for the compiled kernel (the interpreter has no tiling
+    constraints). Mirrors flash_attention.supported(): lane-aligned hidden
+    size so the per-gate slices hit clean (8,128) tiles, and VMEM bounds for
+    the resident RW block and per-step activations."""
+    if interpret:
+        return True
+    return (h % 8 == 0
+            and h * 4 * h * 4 <= 4 * 1024 * 1024      # RW block ≤ 4 MB
+            and b * 4 * h * 4 <= 2 * 1024 * 1024)     # per-step z ≤ 2 MB
+
+
+def _fwd_inference_kernel(gate_in_ref, rw_ref, h0_ref, c0_ref,
+                          hs_ref, cs_ref, h_s, c_s):
+    """Forward without the gates reserve space (parity:
+    cudnnRNNForwardInference vs ForwardTraining — saves the (T,B,4H) HBM
+    write when no backward will run)."""
+    t = pl.program_id(0)
+    H = h_s.shape[-1]
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+        c_s[:] = c0_ref[:]
+
+    z = gate_in_ref[0] + jnp.dot(h_s[:], rw_ref[:],
+                                 preferred_element_type=jnp.float32)
+    i = _sigmoid(z[:, 0 * H:1 * H])
+    f = _sigmoid(z[:, 1 * H:2 * H])
+    o = _sigmoid(z[:, 2 * H:3 * H])
+    g = jnp.tanh(z[:, 3 * H:4 * H])
+    c_new = f * c_s[:] + i * g
+    h_new = o * jnp.tanh(c_new)
+    hs_ref[0] = h_new
+    cs_ref[0] = c_new
+    h_s[:] = h_new
+    c_s[:] = c_new
+
+
 def _fwd_kernel(gate_in_ref, rw_ref, h0_ref, c0_ref,
                 hs_ref, cs_ref, gates_ref, h_s, c_s):
     """One grid step = one timestep. Scratch (h_s, c_s) persists across the
@@ -97,16 +136,9 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
     dg = dc * i
     df = dc * cp
 
-    dz_i = di * i * (1.0 - i)
-    dz_f = df * f * (1.0 - f)
-    dz_o = do * o * (1.0 - o)
-    dz_g = dg * (1.0 - g * g)
-    dz_ref[0, :, 0 * H:1 * H] = dz_i
-    dz_ref[0, :, 1 * H:2 * H] = dz_f
-    dz_ref[0, :, 2 * H:3 * H] = dz_o
-    dz_ref[0, :, 3 * H:4 * H] = dz_g
-
-    dz = jnp.concatenate([dz_i, dz_f, dz_o, dz_g], axis=-1)
+    dz = jnp.concatenate([di * i * (1.0 - i), df * f * (1.0 - f),
+                          do * o * (1.0 - o), dg * (1.0 - g * g)], axis=-1)
+    dz_ref[0] = dz
     # dh_{t-1} recurrent contribution: dz_t @ RW^T  (contract the 4H axis)
     dh_rec = lax.dot_general(dz, rw_ref[:], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -119,36 +151,45 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
     dc0_ref[:] = dc_prev
 
 
-def _fwd_call(gate_in, rw, h0, c0, *, interpret):
+def _fwd_call(gate_in, rw, h0, c0, *, interpret, save_gates=True):
     T, B, G = gate_in.shape
     H = G // 4
     f32 = jnp.float32
-    out_shape = (
-        jax.ShapeDtypeStruct((T, B, H), f32),   # hs
-        jax.ShapeDtypeStruct((T, B, H), f32),   # cs
-        jax.ShapeDtypeStruct((T, B, G), f32),   # gates (post-activation)
-    )
     step_b = lambda t: (t, 0, 0)
     fixed2 = lambda t: (0, 0)
-    hs, cs, gates = pl.pallas_call(
-        _fwd_kernel,
+    in_specs = [
+        pl.BlockSpec((1, B, G), step_b, memory_space=pltpu.VMEM),
+        pl.BlockSpec((H, G), fixed2, memory_space=pltpu.VMEM),
+        pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
+        pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
+    ]
+    state_spec = pl.BlockSpec((1, B, H), step_b, memory_space=pltpu.VMEM)
+    state_shape = jax.ShapeDtypeStruct((T, B, H), f32)
+    scratch = [pltpu.VMEM((B, H), f32), pltpu.VMEM((B, H), f32)]
+    if save_gates:
+        hs, cs, gates = pl.pallas_call(
+            _fwd_kernel,
+            grid=(T,),
+            in_specs=in_specs,
+            out_specs=(state_spec, state_spec,
+                       pl.BlockSpec((1, B, G), step_b,
+                                    memory_space=pltpu.VMEM)),
+            out_shape=(state_shape, state_shape,
+                       jax.ShapeDtypeStruct((T, B, G), f32)),
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(gate_in, rw, h0, c0)
+        return hs, cs, gates
+    hs, cs = pl.pallas_call(
+        _fwd_inference_kernel,
         grid=(T,),
-        in_specs=[
-            pl.BlockSpec((1, B, G), step_b, memory_space=pltpu.VMEM),
-            pl.BlockSpec((H, G), fixed2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, B, H), step_b, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, B, H), step_b, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, B, G), step_b, memory_space=pltpu.VMEM),
-        ),
-        out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((B, H), f32), pltpu.VMEM((B, H), f32)],
+        in_specs=in_specs,
+        out_specs=(state_spec, state_spec),
+        out_shape=(state_shape, state_shape),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(gate_in, rw, h0, c0)
-    return hs, cs, gates
+    return hs, cs, None
 
 
 def _bwd_call(gates, cs, cprev, rw, dhs, dcs, *, interpret):
@@ -192,7 +233,11 @@ def fused_lstm_sequence(gate_in, rw, h0, c0, interpret=False):
     rw: (H, 4H) recurrent weights. h0/c0: (B, H) initial state.
     Returns (hs, cs): per-step hidden and cell states, each (T, B, H).
     """
-    hs, cs, _ = _fwd_call(gate_in, rw, h0, c0, interpret=interpret)
+    # primal (inference-only) call: skip the gates reserve space
+    # (cudnnRNNForwardInference parity); the custom-VJP forward below
+    # re-runs with save_gates=True when a gradient is actually requested.
+    hs, cs, _ = _fwd_call(gate_in, rw, h0, c0, interpret=interpret,
+                          save_gates=False)
     return hs, cs
 
 

@@ -1,0 +1,225 @@
+"""Second-order / line-search solvers.
+
+Parity: reference optimize/Solver.java:43 (facade), solvers/BaseOptimizer.java
+(iteration loop), solvers/StochasticGradientDescent.java, solvers/LBFGS.java,
+solvers/ConjugateGradient.java, solvers/LineGradientDescent.java and
+solvers/BackTrackLineSearch.java (SURVEY.md §2 #6). These are full-batch
+curvature methods driven from the host; minibatch SGD lives in the network
+containers' jit'd train step.
+
+TPU design: parameters are raveled to ONE flat vector
+(jax.flatten_util.ravel_pytree — the functional equivalent of the
+reference's flat params view, nn/api/Model.java:105), and
+``value_and_grad`` of the loss over the flat vector is jit-compiled ONCE;
+every line-search probe or curvature update is then a single fused XLA
+execution. Search-direction algebra (two-loop recursion, Polak–Ribière β)
+runs in jnp on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking line search (parity:
+    optimize/solvers/BackTrackLineSearch.java — same defaults: c1-style
+    sufficient-decrease test, step halving, maxIterations=5)."""
+
+    def __init__(self, c1: float = 1e-4, rho: float = 0.5,
+                 max_iterations: int = 5, initial_step: float = 1.0):
+        self.c1 = c1
+        self.rho = rho
+        self.max_iterations = max_iterations
+        self.initial_step = initial_step
+
+    def optimize(self, vg, x, f0, g0, direction):
+        """Returns (step, f_new, x_new, g_new). vg: jitted value_and_grad."""
+        slope = float(jnp.vdot(g0, direction))
+        if slope >= 0:          # not a descent direction — reset handled above
+            direction = -g0
+            slope = float(jnp.vdot(g0, direction))
+        alpha = self.initial_step
+        best = None
+        for _ in range(self.max_iterations):
+            x_new = x + alpha * direction
+            f_new, g_new = vg(x_new)
+            f_new = float(f_new)
+            if np.isfinite(f_new) and f_new <= f0 + self.c1 * alpha * slope:
+                return alpha, f_new, x_new, g_new
+            if best is None or (np.isfinite(f_new) and f_new < best[1]):
+                best = (alpha, f_new, x_new, g_new)
+            alpha *= self.rho
+        return best if best is not None else (0.0, f0, x, g0)
+
+
+class BaseSolver:
+    """Shared full-batch iteration loop (parity: BaseOptimizer.java:171
+    gradientAndScore + the optimize() template)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 line_search: Optional[BackTrackLineSearch] = None):
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+        self.line_search = line_search or BackTrackLineSearch()
+        self.score_history = []
+
+    def _setup(self, net, ds):
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        mf = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        ml = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        flat0, unravel = ravel_pytree(net.params)
+
+        def loss(vec):
+            l, _ = net._loss(unravel(vec), net.state, x, y, None, mf, ml)
+            return l
+
+        return flat0, unravel, jax.jit(jax.value_and_grad(loss))
+
+    def optimize(self, net, ds):
+        """Full-batch optimization of net's loss on ds; updates net.params.
+        Returns True if converged by tolerance (parity: the boolean from
+        ConjugateGradient/LBFGS.optimize)."""
+        xv, unravel, vg = self._setup(net, ds)
+        f, g = vg(xv)
+        f = float(f)
+        self.score_history = [f]
+        state = self._init_state(xv, g)
+        converged = False
+        for it in range(self.max_iterations):
+            direction, state = self._direction(xv, g, state)
+            step, f_new, x_new, g_new = self.line_search.optimize(
+                vg, xv, f, g, direction)
+            if step == 0.0:
+                break
+            state = self._post_step(state, xv, g, x_new, g_new)
+            xv, g = x_new, g_new
+            old_f, f = f, f_new
+            self.score_history.append(f)
+            net._score = f
+            for lst in net.listeners:
+                lst.iteration_done(net, it, net.epoch)
+            if abs(old_f - f) < self.tolerance * max(1.0, abs(old_f)):
+                converged = True
+                break
+        net.params = unravel(xv)
+        return converged
+
+    # hooks ----------------------------------------------------------------
+    def _init_state(self, x, g):
+        return None
+
+    def _direction(self, x, g, state):
+        raise NotImplementedError
+
+    def _post_step(self, state, x_old, g_old, x_new, g_new):
+        return state
+
+
+class LineGradientDescent(BaseSolver):
+    """Steepest descent + line search (parity:
+    optimize/solvers/LineGradientDescent.java)."""
+
+    def _direction(self, x, g, state):
+        return -g, state
+
+
+class ConjugateGradient(BaseSolver):
+    """Nonlinear CG, Polak–Ribière with automatic restart (parity:
+    optimize/solvers/ConjugateGradient.java)."""
+
+    def _init_state(self, x, g):
+        return {"g_prev": g, "d_prev": -g, "first": True}
+
+    def _direction(self, x, g, state):
+        if state["first"]:
+            state = dict(state, first=False)
+            return -g, state
+        gp = state["g_prev"]
+        beta = float(jnp.vdot(g, g - gp) / jnp.maximum(jnp.vdot(gp, gp), 1e-30))
+        beta = max(beta, 0.0)    # PR+ restart
+        d = -g + beta * state["d_prev"]
+        return d, state
+
+    def _post_step(self, state, x_old, g_old, x_new, g_new):
+        # recompute direction next call from the new gradient
+        d_prev = x_new - x_old   # direction actually taken (scaled)
+        return {"g_prev": g_old, "d_prev": d_prev, "first": False}
+
+
+class LBFGS(BaseSolver):
+    """Limited-memory BFGS, two-loop recursion (parity:
+    optimize/solvers/LBFGS.java — same default memory m=4)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 m: int = 4, line_search: Optional[BackTrackLineSearch] = None):
+        super().__init__(max_iterations, tolerance, line_search)
+        self.m = m
+
+    def _init_state(self, x, g):
+        return {"s": [], "y": []}
+
+    def _direction(self, x, g, state):
+        s_list, y_list = state["s"], state["y"]
+        q = g
+        alphas = []
+        for s, y in zip(reversed(s_list), reversed(y_list)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-30)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if y_list:
+            y_last, s_last = y_list[-1], s_list[-1]
+            gamma = jnp.vdot(s_last, y_last) / jnp.maximum(
+                jnp.vdot(y_last, y_last), 1e-30)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return -q, state
+
+    def _post_step(self, state, x_old, g_old, x_new, g_new):
+        s = x_new - x_old
+        y = g_new - g_old
+        if float(jnp.vdot(s, y)) > 1e-10:   # curvature condition
+            state["s"].append(s)
+            state["y"].append(y)
+            if len(state["s"]) > self.m:
+                state["s"].pop(0)
+                state["y"].pop(0)
+        return state
+
+
+_ALGOS = {
+    "sgd": None,  # handled by the containers' jit'd minibatch step
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+class Solver:
+    """Facade selecting the optimization algorithm (parity:
+    optimize/Solver.java:43 .Builder). ``sgd`` delegates to the network's
+    own minibatch train step; the others run full-batch on the given data."""
+
+    def __init__(self, net, algorithm: str = "sgd", **kwargs):
+        if algorithm not in _ALGOS:
+            raise ValueError(
+                f"unknown algorithm '{algorithm}'; one of {sorted(_ALGOS)}")
+        self.net = net
+        self.algorithm = algorithm
+        self.kwargs = kwargs
+
+    def optimize(self, ds):
+        if self.algorithm == "sgd":
+            self.net.fit(ds)
+            return True
+        solver = _ALGOS[self.algorithm](**self.kwargs)
+        return solver.optimize(self.net, ds)

@@ -1,0 +1,143 @@
+"""Threshold-encoded sparse gradient compression.
+
+Parity: reference optimize/solvers/accumulation/EncodingHandler.java:114
+(encodeUpdates), EncodedGradientsAccumulator.java:33 and nd4j
+``ThresholdCompression`` (SURVEY.md §2 #7) — the Strom-2015-style scheme the
+reference uses for async gradient sharing over threads and Aeron UDP.
+
+On-chip (ICI) gradient exchange needs none of this — XLA's psum moves dense
+bf16 gradients at full ICI bandwidth (parallel/wrapper.py). This module is
+for the one place compression still pays: DCN-spanning pods / multi-host
+WANs (SURVEY.md §5 'keep it only for DCN-spanning pods'), and for parity
+with the reference's ParallelWrapper SHARED mode semantics.
+
+TPU design: the reference emits a variable-length int array (dynamic shape —
+hostile to XLA). Here encode is a FIXED-CAPACITY jit-able kernel: top-K of
+|g| above threshold → (indices, signed values, count), so the message shape
+is static and the whole encode→decode→residual pipeline stays on device.
+The un-sent remainder is carried as a residual and re-applied next step
+(exactly the accumulator's deferred-updates semantics)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(2,))
+def threshold_encode(grad, threshold, capacity):
+    """Encode |g| >= threshold entries, at most ``capacity`` of them (largest
+    first). Returns (indices int32[capacity], values f32[capacity], count).
+    Unused slots have index -1 / value 0."""
+    flat = grad.reshape(-1)
+    mag = jnp.abs(flat)
+    v, idx = jax.lax.top_k(mag, capacity)
+    keep = v >= threshold
+    count = keep.sum(dtype=jnp.int32)
+    # the reference transmits sign * threshold, not the raw value
+    # (ThresholdCompression 1-bit style); residual keeps the difference.
+    vals = jnp.where(keep, jnp.sign(flat[idx]) * threshold, 0.0)
+    idx = jnp.where(keep, idx, -1)
+    return idx.astype(jnp.int32), vals.astype(jnp.float32), count
+
+
+@partial(jax.jit, static_argnums=(2,))
+def threshold_decode(indices, values, n):
+    """Dense f32[n] vector from an encoded message."""
+    safe = jnp.where(indices < 0, 0, indices)
+    dense = jnp.zeros((n,), jnp.float32).at[safe].add(
+        jnp.where(indices < 0, 0.0, values))
+    return dense
+
+
+class EncodingHandler:
+    """Stateful encoder with residual carry + adaptive threshold (parity:
+    EncodingHandler.java threshold decay/"shake" and
+    SharedTrainingMaster.java:70-99 thresholdStep/minThreshold/shakeFrequency).
+
+    encode() returns the message AND retains (grad - decoded) as residual,
+    which is added to the next gradient before encoding — the reference's
+    deferred-updates semantics."""
+
+    def __init__(self, threshold: float = 1e-3, min_threshold: float = 1e-5,
+                 threshold_step: float = 1e-5, shake_frequency: int = 0,
+                 capacity_fraction: float = 0.1):
+        self.threshold = float(threshold)
+        self.min_threshold = float(min_threshold)
+        self.threshold_step = float(threshold_step)
+        self.shake_frequency = int(shake_frequency)
+        self.capacity_fraction = float(capacity_fraction)
+        self.residual: Optional[jax.Array] = None
+        self.iteration = 0
+
+    def _capacity(self, n):
+        return max(1, min(n, int(n * self.capacity_fraction)))
+
+    def encode(self, grad):
+        """grad: any pytree/array; flattened internally. Returns
+        (indices, values, count) with static shapes."""
+        flat = jnp.concatenate([a.reshape(-1) for a in
+                                jax.tree_util.tree_leaves(grad)]) \
+            if not isinstance(grad, jax.Array) else grad.reshape(-1)
+        if self.residual is not None:
+            flat = flat + self.residual
+        cap = self._capacity(flat.shape[0])
+        idx, vals, count = threshold_encode(flat, self.threshold, cap)
+        sent = threshold_decode(idx, vals, flat.shape[0])
+        self.residual = flat - sent
+        self._adapt(int(count), cap)
+        self.iteration += 1
+        return idx, vals, count
+
+    def _adapt(self, count, cap):
+        """Threshold decay when too little is sent; periodic 'shake' lowers
+        it to flush stale residuals (EncodingHandler semantics)."""
+        if count >= cap:            # saturated: raise threshold
+            self.threshold += self.threshold_step
+        elif count < cap // 4:      # sparse: decay toward min
+            self.threshold = max(self.min_threshold,
+                                 self.threshold - self.threshold_step)
+        if (self.shake_frequency and self.iteration > 0
+                and self.iteration % self.shake_frequency == 0):
+            self.threshold = max(self.min_threshold, self.threshold * 0.5)
+
+    def reset(self):
+        self.residual = None
+        self.iteration = 0
+
+
+class EncodedGradientsAccumulator:
+    """In-process multi-worker exchange of encoded updates (parity:
+    optimize/solvers/accumulation/EncodedGradientsAccumulator.java:33 +
+    FancyBlockingQueue). Each worker stores its encoded message; every
+    worker then applies everyone's updates locally. Synchronous two-phase
+    use (store all → apply all) replaces the reference's lock-free queues —
+    device-side math is identical."""
+
+    def __init__(self, n_workers: int, n_params: int, **handler_kwargs):
+        self.n_workers = n_workers
+        self.n_params = n_params
+        self.handlers = [EncodingHandler(**handler_kwargs)
+                         for _ in range(n_workers)]
+        self._pending = [[] for _ in range(n_workers)]
+
+    def store_update(self, worker: int, grad):
+        """Encode worker's gradient and broadcast to all others' queues
+        (EncodingHandler.broadcastUpdates :210)."""
+        msg = self.handlers[worker].encode(grad)
+        for w in range(self.n_workers):
+            self._pending[w].append(msg)
+        return msg
+
+    def apply_update(self, worker: int):
+        """Sum of all pending decoded updates for this worker; clears its
+        queue. Returns a dense f32[n_params] update vector."""
+        dense = jnp.zeros((self.n_params,), jnp.float32)
+        for idx, vals, _ in self._pending[worker]:
+            dense = dense + threshold_decode(idx, vals, self.n_params)
+        self._pending[worker] = []
+        return dense

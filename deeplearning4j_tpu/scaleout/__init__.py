@@ -1,0 +1,32 @@
+"""Cluster-scale training (the reference's deeplearning4j-scaleout/spark
+stack, re-designed TPU-first).
+
+Parity surface (SURVEY.md §2 #22/#23): TrainingMaster SPI,
+ParameterAveragingTrainingMaster, SharedTrainingMaster,
+SparkDl4jMultiLayer-style cluster facades, SparkTrainingStats.
+
+TPU design: there is no Spark. The cluster runtime is the JAX multi-host
+process group (jax.distributed over DCN) and the "executors" are mesh
+devices; collectives ride ICI/DCN via XLA (scaling-book recipe). The SPI is
+kept so training policy (sync averaging vs gradient sharing, averaging
+frequency, repartitioning, stats collection) stays pluggable exactly where
+the reference put it.
+"""
+
+from deeplearning4j_tpu.scaleout.training_master import (
+    TrainingMaster,
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+    TrainingStats,
+)
+from deeplearning4j_tpu.scaleout.cluster import (
+    ClusterMultiLayerNetwork,
+    ClusterComputationGraph,
+    repartition,
+)
+
+__all__ = [
+    "TrainingMaster", "ParameterAveragingTrainingMaster",
+    "SharedTrainingMaster", "TrainingStats",
+    "ClusterMultiLayerNetwork", "ClusterComputationGraph", "repartition",
+]

@@ -1,0 +1,90 @@
+"""Cluster training facades.
+
+Parity: reference spark/impl/multilayer/SparkDl4jMultiLayer.java:71
+(fit :214, evaluate, scoring), spark/impl/graph/SparkComputationGraph.java,
+spark/util repartitioning (spark/api/Repartition.java).
+
+TPU design: the "cluster" is the JAX process group + device mesh; a
+"partition" is a host-local shard of the dataset. The facade owns a
+network + a TrainingMaster and forwards fit/evaluate, mirroring the Spark
+wrappers' API so reference users find the same shape:
+
+    master = ParameterAveragingTrainingMaster(averaging_frequency=4)
+    cluster_net = ClusterMultiLayerNetwork(net, master)
+    cluster_net.fit(batches)           # Spark: fit(JavaRDD<DataSet>)
+    ev = cluster_net.evaluate(batches)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+def repartition(batches, batch_size: int, seed: Optional[int] = None):
+    """Re-cut a list/iterable of DataSets into equal-size minibatches,
+    optionally shuffling examples across partitions (parity:
+    spark/api/Repartition + RepartitionStrategy.Balanced — Spark needed
+    this because partition skew starved executors; here it balances the
+    per-step batch across mesh devices)."""
+    items = [b if isinstance(b, DataSet) else DataSet(*b) for b in batches]
+    if not items:
+        return []
+    feats = np.concatenate([np.asarray(d.features) for d in items])
+    labs = np.concatenate([np.asarray(d.labels) for d in items])
+    if seed is not None:
+        perm = np.random.RandomState(seed).permutation(len(feats))
+        feats, labs = feats[perm], labs[perm]
+    out: List[DataSet] = []
+    for i in range(0, len(feats), batch_size):
+        if i + batch_size <= len(feats):
+            out.append(DataSet(feats[i:i + batch_size], labs[i:i + batch_size]))
+    rem = len(feats) % batch_size
+    if rem:
+        out.append(DataSet(feats[-rem:], labs[-rem:]))
+    return out
+
+
+class _ClusterModel:
+    def __init__(self, net, training_master):
+        self.net = net
+        self.master = training_master
+
+    def fit(self, data, epochs: int = 1):
+        """data: iterable of DataSets (the RDD equivalent)."""
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            self.master.execute_training(self.net, data)
+            self.net.epoch += 1
+        return self.net
+
+    def evaluate(self, data):
+        return self.net.evaluate(data)
+
+    def score_examples(self, data):
+        """Per-minibatch mean scores (parity:
+        SparkDl4jMultiLayer.scoreExamples)."""
+        scores = []
+        for ds in data:
+            if not isinstance(ds, DataSet):
+                ds = DataSet(*ds)
+            scores.append(self.net.score(ds))
+        return scores
+
+    def get_network(self):
+        return self.net
+
+    def get_training_master(self):
+        return self.master
+
+
+class ClusterMultiLayerNetwork(_ClusterModel):
+    """Parity: SparkDl4jMultiLayer.java:71."""
+
+
+class ClusterComputationGraph(_ClusterModel):
+    """Parity: SparkComputationGraph.java."""

@@ -126,10 +126,15 @@ class TestFlashAttention:
 
     def test_layer_routes_through_flash(self, helpers_on):
         from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
-        lyr = MultiHeadAttention(n_in=8, n_heads=2, causal=True)
-        lyr.set_n_in(type("T", (), {"size": 8, "flat_size": lambda s: 8})())
+        lyr = MultiHeadAttention(n_in=16, n_heads=2, causal=True)
+        lyr.set_n_in(type("T", (), {"size": 16, "flat_size": lambda s: 16})())
         params = lyr.init(jax.random.PRNGKey(0))
-        x = jnp.asarray(np.random.RandomState(2).randn(2, 16, 8), jnp.float32)
+        # Dh = 16/2 = 8 satisfies supported()'s dh % 8 == 0, so this shape
+        # actually engages the flash kernel (smaller Dh falls back and the
+        # comparison would be vacuous)
+        from deeplearning4j_tpu.ops.flash_attention import supported
+        assert supported(16, 8)
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 16, 16), jnp.float32)
         y_fa, _ = lyr.apply(params, x)
         ops.set_helpers_enabled(False)
         y_ref, _ = lyr.apply(params, x)
