@@ -1,0 +1,171 @@
+"""KNN REST server + client.
+
+Parity: reference deeplearning4j-nearestneighbor-server/
+NearestNeighborsServer.java (Play REST service over a VPTree index),
+-client/NearestNeighborsClient.java (JSON + Base64 NDArray transport),
+-model (request/response DTOs).
+
+Design: stdlib ThreadingHTTPServer; the index is the device-side brute-force
+``NearestNeighbors`` (one XLA distance matmul per batch — the TPU-idiomatic
+choice; the reference needed a VPTree because JVM-side distance loops were
+slow) with a VPTree fallback for hosts without an accelerator. Array
+transport is Base64 of raw little-endian f32 plus a shape header — same
+role as the reference's Base64 NDArray codec."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+import urllib.request
+
+import numpy as np
+
+
+def ndarray_to_b64(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    return {"shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode()}
+
+
+def ndarray_from_b64(obj: dict) -> np.ndarray:
+    raw = base64.b64decode(obj["data"])
+    return np.frombuffer(raw, dtype=np.float32).reshape(obj["shape"]).copy()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        srv = self.server.knn
+        path = urlparse(self.path).path
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(n).decode())
+        except Exception as e:
+            self._json({"error": f"bad json: {e}"}, 400)
+            return
+        try:
+            if path == "/knn":            # by index into the corpus
+                idx = int(payload["index"])
+                k = int(payload.get("k", 1))
+                q = srv.points[idx:idx + 1]
+                ids, dists = srv.query(q, k + 1)
+                # drop the query point itself (reference does the same)
+                results = [{"index": int(i), "distance": float(d)}
+                           for i, d in zip(ids[0], dists[0])
+                           if int(i) != idx][:k]
+                self._json({"results": results})
+            elif path == "/knnnew":       # by raw vector
+                k = int(payload.get("k", 1))
+                q = ndarray_from_b64(payload["ndarray"])
+                if q.ndim == 1:
+                    q = q[None, :]
+                ids, dists = srv.query(q, k)
+                self._json({"results": [
+                    [{"index": int(i), "distance": float(d)}
+                     for i, d in zip(row_i, row_d)]
+                    for row_i, row_d in zip(ids, dists)]})
+            else:
+                self._json({"error": "not found"}, 404)
+        except Exception as e:  # noqa: BLE001 — service must answer
+            self._json({"error": str(e)}, 500)
+
+
+class NearestNeighborsServer:
+    """Serve KNN over a fixed corpus (parity: NearestNeighborsServer.java).
+
+        srv = NearestNeighborsServer(points, port=0).start()
+        ... NearestNeighborsClient(f"http://localhost:{srv.port}")
+    """
+
+    def __init__(self, points: np.ndarray, port: int = 9200,
+                 use_device: bool = True):
+        self.points = np.asarray(points, dtype=np.float32)
+        self._port_req = port
+        self.use_device = use_device
+        self._index = None
+        self._httpd = None
+        self.port: Optional[int] = None
+
+    def _build_index(self):
+        if self.use_device:
+            try:
+                from deeplearning4j_tpu.clustering.knn import NearestNeighbors
+                self._index = NearestNeighbors(self.points)
+                return
+            except Exception:
+                pass
+        from deeplearning4j_tpu.clustering.trees import VPTree
+        self._index = VPTree(self.points)
+
+    def query(self, q: np.ndarray, k: int):
+        k = min(k, len(self.points))
+        if hasattr(self._index, "knn") and self._index.__class__.__name__ \
+                == "NearestNeighbors":
+            ids, dists = self._index.knn(q, k)
+            return np.asarray(ids), np.asarray(dists)
+        ids, dists = [], []
+        for row in q:
+            i, d = self._index.knn(row, k)
+            ids.append(i)
+            dists.append(d)
+        return np.asarray(ids), np.asarray(dists)
+
+    def start(self):
+        self._build_index()
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self._port_req),
+                                          _Handler)
+        self._httpd.knn = self
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+class NearestNeighborsClient:
+    """Parity: NearestNeighborsClient.java."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path, payload):
+        req = urllib.request.Request(
+            self.url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                out = json.loads(e.read().decode())
+            except Exception:
+                raise RuntimeError(f"HTTP {e.code}") from e
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    def knn(self, index: int, k: int):
+        return self._post("/knn", {"index": index, "k": k})["results"]
+
+    def knn_new(self, vector: np.ndarray, k: int):
+        return self._post("/knnnew", {
+            "k": k, "ndarray": ndarray_to_b64(np.asarray(vector))})["results"]

@@ -1,0 +1,138 @@
+"""Native-backed data loading: C++ record readers + async prefetch iterator.
+
+Parity: DataVec record readers (reference datasets/datavec/
+RecordReaderDataSetIterator bridge) and AsyncDataSetIterator
+(nn/.../datasets/iterator/AsyncDataSetIterator.java — the prefetch thread
+wrapped around every fit(), MultiLayerNetwork.java:1161). Here the parse +
+shuffle + gather + copy pipeline runs in C++ worker threads
+(native/recordreader.cpp), overlapping ETL with the jit'd train step
+without fighting the GIL. Falls back to the pure-Python readers/iterators
+when the toolchain is unavailable."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu import native
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def load_idx_native(img_path: str, lab_path: str, n_classes: int = 10):
+    """IDX (MNIST/EMNIST) → (x f32[n, rows*cols] /255, y one-hot). Raises
+    on malformed files; returns None if the native lib is unavailable."""
+    lib = native.get_lib()
+    if lib is None:
+        return None
+    n = ctypes.c_int64()
+    feat = ctypes.c_int64()
+    rc = lib.idx_load(img_path.encode(), lab_path.encode(), n_classes,
+                      ctypes.byref(n), ctypes.byref(feat), None, None)
+    if rc != 0:
+        raise ValueError(f"idx_load failed (code {rc}) for {img_path}")
+    x = np.empty((n.value, feat.value), np.float32)
+    y = np.empty((n.value, max(n_classes, 1)), np.float32)
+    rc = lib.idx_load(img_path.encode(), lab_path.encode(), n_classes,
+                      ctypes.byref(n), ctypes.byref(feat),
+                      _fptr(x), _fptr(y))
+    if rc != 0:
+        raise ValueError(f"idx_load failed (code {rc}) for {img_path}")
+    return x, y
+
+
+def load_csv_native(path: str, label_col: int = -1, n_classes: int = 0,
+                    skip_lines: int = 0, delimiter: str = ","):
+    """CSV → (x, y). label_col=-1 → no label column (y empty).
+    Returns None if the native lib is unavailable."""
+    lib = native.get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    d = ctypes.c_char(delimiter.encode())
+    rc = lib.csv_dims(path.encode(), skip_lines, d,
+                      ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        raise ValueError(f"csv_dims failed (code {rc}) for {path}")
+    n, c = rows.value, cols.value
+    n_feat = c - 1 if label_col >= 0 else c
+    ydim = n_classes if n_classes > 0 else 1
+    x = np.empty((n, n_feat), np.float32)
+    y = np.zeros((n, ydim), np.float32)
+    rc = lib.csv_load(path.encode(), skip_lines, d, c, label_col,
+                      n_classes, _fptr(x), _fptr(y))
+    if rc != 0:
+        raise ValueError(f"csv_load failed (code {rc}) for {path}")
+    if label_col < 0:
+        return x, None
+    return x, y
+
+
+class NativeAsyncDataSetIterator(DataSetIterator):
+    """Async minibatch iterator over in-memory arrays, batches assembled by
+    a C++ worker thread into a bounded queue (AsyncDataSetIterator parity;
+    ``prefetch`` = queue capacity, reference default 4). Shuffles per epoch
+    with seed+epoch like the Python ListDataSetIterator."""
+
+    def __init__(self, features, labels, batch_size: int, shuffle=True,
+                 seed: int = 123, prefetch: int = 4):
+        lib = native.get_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native library unavailable — use AsyncDataSetIterator")
+        self._lib = lib
+        # keep contiguous copies alive for the C++ thread
+        self._x = np.ascontiguousarray(features, np.float32)
+        self._y = np.ascontiguousarray(labels, np.float32)
+        self._n = self._x.shape[0]
+        self._xdim = int(np.prod(self._x.shape[1:]))
+        self._ydim = int(np.prod(self._y.shape[1:]))
+        self._xshape = self._x.shape[1:]
+        self._yshape = self._y.shape[1:]
+        self.batch_size = batch_size
+        self._h = lib.batcher_create(
+            _fptr(self._x), _fptr(self._y), self._n, self._xdim, self._ydim,
+            batch_size, 1 if shuffle else 0, seed, prefetch)
+        self._done = False
+
+    def __next__(self) -> DataSet:
+        if self._h is None:
+            raise StopIteration
+        xb = np.empty((self.batch_size, self._xdim), np.float32)
+        yb = np.empty((self.batch_size, self._ydim), np.float32)
+        cnt = self._lib.batcher_next(self._h, _fptr(xb), _fptr(yb))
+        if cnt == 0:
+            raise StopIteration
+        return DataSet(xb[:cnt].reshape((cnt,) + self._xshape),
+                       yb[:cnt].reshape((cnt,) + self._yshape))
+
+    def reset(self):
+        if self._h is not None:
+            self._lib.batcher_reset(self._h)
+
+    def batch(self):
+        return self.batch_size
+
+    def total_outcomes(self):
+        return self._ydim
+
+    def input_columns(self):
+        return self._xdim
+
+    def close(self):
+        if self._h is not None:
+            self._lib.batcher_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -52,10 +52,15 @@ class BackTrackLineSearch:
             f_new = float(f_new)
             if np.isfinite(f_new) and f_new <= f0 + self.c1 * alpha * slope:
                 return alpha, f_new, x_new, g_new
-            if best is None or (np.isfinite(f_new) and f_new < best[1]):
+            if np.isfinite(f_new) and (best is None or f_new < best[1]):
                 best = (alpha, f_new, x_new, g_new)
             alpha *= self.rho
-        return best if best is not None else (0.0, f0, x, g0)
+        # no probe satisfied Armijo: only accept a finite, strictly
+        # improving fallback — otherwise signal failure with step 0 (the
+        # reference's BackTrackLineSearch failure contract)
+        if best is not None and best[1] < f0:
+            return best
+        return (0.0, f0, x, g0)
 
 
 class BaseSolver:

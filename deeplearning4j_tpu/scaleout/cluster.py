@@ -33,19 +33,10 @@ def repartition(batches, batch_size: int, seed: Optional[int] = None):
     items = [b if isinstance(b, DataSet) else DataSet(*b) for b in batches]
     if not items:
         return []
-    feats = np.concatenate([np.asarray(d.features) for d in items])
-    labs = np.concatenate([np.asarray(d.labels) for d in items])
+    merged = DataSet.merge(items)   # mask-preserving
     if seed is not None:
-        perm = np.random.RandomState(seed).permutation(len(feats))
-        feats, labs = feats[perm], labs[perm]
-    out: List[DataSet] = []
-    for i in range(0, len(feats), batch_size):
-        if i + batch_size <= len(feats):
-            out.append(DataSet(feats[i:i + batch_size], labs[i:i + batch_size]))
-    rem = len(feats) % batch_size
-    if rem:
-        out.append(DataSet(feats[-rem:], labs[-rem:]))
-    return out
+        merged.shuffle(seed)
+    return merged.batch_by(batch_size)
 
 
 class _ClusterModel:

@@ -112,6 +112,18 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
     def execute_training(self, net, data):
         pw = self._wrapper(net)
+        if self.repartition_data and self.batch_size_per_worker:
+            # one step consumes batch_size_per_worker × workers examples
+            # (each mesh device = one Spark-executor-equivalent)
+            from deeplearning4j_tpu.scaleout.cluster import repartition
+            if self.stats is not None:
+                with self.stats.time("repartition"):
+                    data = repartition(
+                        list(data),
+                        self.batch_size_per_worker * pw.n_devices)
+            else:
+                data = repartition(
+                    list(data), self.batch_size_per_worker * pw.n_devices)
         if self.stats is not None:
             with self.stats.time("fit"):
                 pw.fit(data)
@@ -146,12 +158,14 @@ class SharedTrainingMaster(TrainingMaster):
         self.workers = workers
         self.batch_size_per_worker = batch_size_per_worker
         self.learning_rate = learning_rate
+        self._net = None
         self._acc: Optional[EncodedGradientsAccumulator] = None
         self._grad_fn = None
         self._unravel = None
         self._n_params = None
 
     def _setup(self, net):
+        self._net = net
         flat, unravel = ravel_pytree(net.params)
         self._n_params = flat.shape[0]
         self._unravel = unravel
@@ -161,9 +175,9 @@ class SharedTrainingMaster(TrainingMaster):
             threshold_step=self.threshold_step,
             shake_frequency=self.shake_frequency)
 
-        def grad(vec, x, y, lr):
+        def grad(vec, state, x, y, lr):
             loss, g = jax.value_and_grad(
-                lambda v: net._loss(unravel(v), net.state, x, y, None,
+                lambda v: net._loss(unravel(v), state, x, y, None,
                                     None, None)[0])(vec)
             # the reference encodes the post-updater UPDATE, not the raw
             # gradient (SharedTrainingWrapper applies the updater first;
@@ -174,29 +188,43 @@ class SharedTrainingMaster(TrainingMaster):
         self._grad_fn = jax.jit(grad)
 
     def execute_training(self, net, data):
-        """Round-robins minibatches over logical workers; each stores its
-        encoded update then applies all pending updates (scaled by the
-        updater's LR) — SharedTrainingWrapper.run semantics."""
-        if self._acc is None:
+        """Round-robins minibatches over per-worker model replicas; each
+        worker computes its gradient on ITS replica, broadcasts the encoded
+        update, and applies every pending update (its own + peers') to its
+        replica exactly once — SharedTrainingWrapper.run semantics. Replicas
+        stay in sync because the exchange is synchronous (SURVEY.md §5:
+        async Aeron staleness intentionally not reproduced)."""
+        if self._acc is None or self._net is not net:
             self._setup(net)
         lr = self.learning_rate
         if lr is None:
             upd = net.conf.global_conf.updater
             lr = getattr(upd, "learning_rate", 0.01)
-        vec, _ = ravel_pytree(net.params)
+        if self.batch_size_per_worker:
+            from deeplearning4j_tpu.scaleout.cluster import repartition
+            data = repartition(list(data), self.batch_size_per_worker)
+        vec0, _ = ravel_pytree(net.params)
+        replicas = [vec0] * self.workers
         w = 0
         losses = []
         for ds in data:
             if not isinstance(ds, DataSet):
                 ds = DataSet(*ds)
             x, y = jnp.asarray(ds.features), jnp.asarray(ds.labels)
-            loss, u = self._grad_fn(vec, x, y, lr)
+            loss, u = self._grad_fn(replicas[w], net.state, x, y, lr)
             losses.append(float(loss))
             self._acc.store_update(w, u)
-            # decoded messages are already updates — applied directly
-            vec = vec - self._acc.apply_update(w)
+            # drain this worker's queue: every message lands exactly once
+            # per replica
+            replicas[w] = replicas[w] - self._acc.apply_update(w)
             w = (w + 1) % self.workers
             net.iteration += 1
+        # flush remaining queued updates so all replicas converge, then
+        # average (they are near-identical; averaging is the reference's
+        # final transfer of the best model back to the source)
+        for w2 in range(self.workers):
+            replicas[w2] = replicas[w2] - self._acc.apply_update(w2)
+        vec = sum(replicas) / self.workers
         net.params = self._unravel(vec)
         net._score = float(np.mean(losses)) if losses else float("nan")
         return net

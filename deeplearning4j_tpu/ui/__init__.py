@@ -1,0 +1,27 @@
+"""Training observability (the reference's deeplearning4j-ui stack,
+re-designed without Play/SBE/Scala).
+
+Parity surface (SURVEY.md §2 #16/#32/#33/#34):
+- StatsStorage API + in-memory/file impls (api/storage/StatsStorage.java,
+  ui-model storage impls)
+- StatsListener collecting per-iteration score/params/updates/memory
+  (ui-model stats/BaseStatsListener.java:286)
+- binary stats codec (stats/impl/SbeStatsReport.java — here a compact
+  struct-packed record format instead of SBE)
+- web UI server with train overview/model pages + remote stats receiver
+  (deeplearning4j-play PlayUIServer.java, module/remote/RemoteReceiverModule)
+- RemoteUIStatsStorageRouter posting stats over HTTP
+  (core api/storage/impl/RemoteUIStatsStorageRouter.java)
+"""
+
+from deeplearning4j_tpu.ui.storage import (
+    StatsStorage, InMemoryStatsStorage, FileStatsStorage,
+    RemoteUIStatsStorageRouter, StatsReport,
+)
+from deeplearning4j_tpu.ui.stats_listener import StatsListener
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = [
+    "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
+    "RemoteUIStatsStorageRouter", "StatsReport", "StatsListener", "UIServer",
+]

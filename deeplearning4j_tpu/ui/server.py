@@ -1,0 +1,207 @@
+"""Web UI server: train overview + model info + remote stats receiver.
+
+Parity: deeplearning4j-play PlayUIServer.java (singleton ``UIServer
+.get_instance().attach(storage)``), module/train/TrainModule.java (overview
+and model endpoints), module/remote/RemoteReceiverModule.java (POST /remote).
+
+Design: stdlib ThreadingHTTPServer — no Play/netty equivalent needed; the
+overview page is a single self-contained HTML document (inline canvas
+charts, fetch polling — no external assets, works in air-gapped pods)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import urlparse, parse_qs
+
+from deeplearning4j_tpu.ui.storage import StatsStorage, StatsReport
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Training UI</title><style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} h2{font-size:16px}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;
+      padding:12px;margin:10px 0}
+canvas{width:100%;height:220px}
+table{border-collapse:collapse;font-size:13px}
+td,th{border:1px solid #ccc;padding:3px 8px;text-align:right}
+th{background:#eee}
+select{font-size:14px;padding:2px}
+</style></head><body>
+<h1>deeplearning4j_tpu &mdash; training overview</h1>
+<div class="card">Session: <select id="sess"></select>
+ <span id="meta"></span></div>
+<div class="card"><h2>Score vs iteration</h2><canvas id="score"></canvas></div>
+<div class="card"><h2>Iteration time (ms)</h2><canvas id="time"></canvas></div>
+<div class="card"><h2>Parameter norms (latest)</h2><div id="params"></div></div>
+<script>
+function line(id, xs, ys){
+  const c=document.getElementById(id);
+  c.width=c.clientWidth; c.height=c.clientHeight;
+  const g=c.getContext('2d');
+  g.clearRect(0,0,c.width,c.height);
+  if(ys.length<2) return;
+  const fy=ys.filter(Number.isFinite);
+  const ymin=Math.min(...fy), ymax=Math.max(...fy);
+  const sx=(c.width-50)/(xs.length-1), sy=(c.height-30)/((ymax-ymin)||1);
+  g.strokeStyle='#2a6cc4'; g.lineWidth=1.5; g.beginPath();
+  ys.forEach((y,i)=>{const px=40+i*sx, py=c.height-20-(y-ymin)*sy;
+    i?g.lineTo(px,py):g.moveTo(px,py);});
+  g.stroke();
+  g.fillStyle='#333'; g.font='11px sans-serif';
+  g.fillText(ymax.toPrecision(4),2,12);
+  g.fillText(ymin.toPrecision(4),2,c.height-22);
+}
+async function refresh(){
+  const sel=document.getElementById('sess');
+  const sids=await (await fetch('train/sessions')).json();
+  if(sel.options.length!=sids.length){
+    sel.innerHTML=sids.map(s=>`<option>${s}</option>`).join('');
+  }
+  if(!sel.value) return;
+  const ov=await (await fetch('train/overview?sid='+sel.value)).json();
+  line('score', ov.iterations, ov.scores);
+  line('time', ov.iterations, ov.iterationTimesMs);
+  document.getElementById('meta').textContent=
+    ` ${ov.iterations.length} updates, last score `+
+    `${(ov.scores.at(-1)??NaN).toPrecision(5)}`;
+  const ps=ov.latestParamStats||{};
+  document.getElementById('params').innerHTML =
+    '<table><tr><th>group</th><th>mean</th><th>std</th><th>norm</th></tr>'+
+    Object.entries(ps).map(([k,v])=>
+      `<tr><td>${k}</td><td>${v.mean.toPrecision(4)}</td>`+
+      `<td>${v.std.toPrecision(4)}</td><td>${v.norm.toPrecision(4)}</td></tr>`)
+      .join('')+'</table>';
+}
+setInterval(refresh, 2000); refresh();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTpuUI/1.0"
+
+    def log_message(self, *args):  # silence request spam
+        pass
+
+    @property
+    def storages(self) -> List[StatsStorage]:
+        return self.server.ui.storages
+
+    def _json(self, obj, code=200):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        if u.path in ("/", "/train", "/train/overview.html"):
+            data = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        if u.path == "/train/sessions":
+            sids = []
+            for st in self.storages:
+                sids.extend(st.list_session_ids())
+            self._json(sorted(set(sids)))
+            return
+        if u.path == "/train/overview":
+            sid = parse_qs(u.query).get("sid", [None])[0]
+            ups: List[StatsReport] = []
+            for st in self.storages:
+                ups.extend(st.get_all_updates(sid) if sid else [])
+            ups.sort(key=lambda r: r.iteration)
+            self._json({
+                "iterations": [r.iteration for r in ups],
+                "scores": [r.score for r in ups],
+                "iterationTimesMs": [r.iteration_time_ms for r in ups],
+                "latestParamStats": ups[-1].param_stats if ups else {},
+            })
+            return
+        if u.path == "/train/model":
+            sid = parse_qs(u.query).get("sid", [None])[0]
+            for st in self.storages:
+                info = st.get_static_info(sid)
+                if info:
+                    self._json(info)
+                    return
+            self._json({}, 404)
+            return
+        self._json({"error": "not found", "path": u.path}, 404)
+
+    def do_POST(self):
+        u = urlparse(self.path)
+        if u.path != "/remote":
+            self._json({"error": "not found"}, 404)
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n).decode())
+        target = self.server.ui.remote_storage
+        if target is None:
+            self._json({"error": "no remote storage attached"}, 503)
+            return
+        if payload.get("type") == "static":
+            target.put_static_info(payload["sessionId"], payload["info"])
+        elif payload.get("type") == "update":
+            target.put_update(StatsReport.from_bytes(
+                bytes.fromhex(payload["record"])))
+        else:
+            self._json({"error": "unknown type"}, 400)
+            return
+        self._json({"status": "ok"})
+
+
+class UIServer:
+    """Parity: PlayUIServer. ``UIServer.get_instance()`` starts (or returns)
+    the singleton; ``attach(storage)`` adds a stats source;
+    ``enable_remote_listener()`` makes POST /remote feed a storage."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.storages: List[StatsStorage] = []
+        self.remote_storage: Optional[StatsStorage] = None
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._httpd.ui = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage):
+        if storage not in self.storages:
+            self.storages.append(storage)
+        return self
+
+    def detach(self, storage: StatsStorage):
+        if storage in self.storages:
+            self.storages.remove(storage)
+        return self
+
+    def enable_remote_listener(self, storage: Optional[StatsStorage] = None,
+                               attach: bool = True):
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        self.remote_storage = storage or InMemoryStatsStorage()
+        if attach:
+            self.attach(self.remote_storage)
+        return self.remote_storage
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

@@ -1,0 +1,96 @@
+"""StatsListener — collects per-iteration training stats into a
+StatsStorage(-Router).
+
+Parity: ui-model stats/BaseStatsListener.java:286 (iterationDone): score,
+timing, samples/batches per sec, memory, per-layer parameter/update
+summary statistics, learning rates; an initial static report carries model
+info (config JSON, param counts). Histograms are reduced to
+mean/std/min/max/norm — the overview charts consume exactly these."""
+
+from __future__ import annotations
+
+import resource
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+import jax
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.ui.storage import StatsReport
+
+
+def _summary(tree) -> dict:
+    out = {}
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        a = np.asarray(leaf, dtype=np.float32)
+        if a.size == 0:
+            continue
+        out[str(i)] = {
+            "mean": float(a.mean()), "std": float(a.std()),
+            "min": float(a.min()), "max": float(a.max()),
+            "norm": float(np.sqrt((a.astype(np.float64) ** 2).sum())),
+        }
+    return out
+
+
+class StatsListener(IterationListener):
+    def __init__(self, storage, frequency: int = 1,
+                 session_id: Optional[str] = None,
+                 collect_param_stats: bool = True):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"session_{uuid.uuid4().hex[:10]}"
+        self.collect_param_stats = collect_param_stats
+        self._last_time = None
+        self._last_params = None
+        self._static_sent = False
+
+    def _send_static(self, model):
+        info = {
+            "model": type(model).__name__,
+            "numParams": int(model.num_params()),
+            "numLayers": len(model.layers),
+            "layers": [type(l).__name__ for l in model.layers],
+        }
+        try:
+            info["configJson"] = model.conf.to_json()
+        except Exception:
+            pass
+        self.storage.put_static_info(self.session_id, info)
+        self._static_sent = True
+
+    def iteration_done(self, model, iteration, epoch):
+        if not self._static_sent:
+            self._send_static(model)
+        if iteration % self.frequency != 0:
+            return
+        now = time.time()
+        dt_ms = 0.0
+        if self._last_time is not None:
+            dt_ms = (now - self._last_time) * 1e3
+        self._last_time = now
+
+        r = StatsReport(session_id=self.session_id, timestamp=now,
+                        iteration=iteration, epoch=epoch,
+                        score=float(model.get_score()),
+                        iteration_time_ms=dt_ms)
+        if dt_ms > 0:
+            r.batches_per_sec = 1e3 / dt_ms
+        r.mem_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+        if self.collect_param_stats and model.params is not None:
+            r.param_stats = _summary(model.params)
+            if self._last_params is not None:
+                delta = jax.tree_util.tree_map(
+                    lambda a, b: np.asarray(a) - np.asarray(b),
+                    model.params, self._last_params)
+                r.update_stats = _summary(delta)
+            self._last_params = jax.tree_util.tree_map(np.asarray, model.params)
+
+        gc = model.conf.global_conf
+        upd = getattr(gc, "updater", None)
+        if upd is not None and hasattr(upd, "learning_rate"):
+            r.learning_rates = {"global": float(upd.learning_rate)}
+        self.storage.put_update(r)

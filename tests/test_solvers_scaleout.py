@@ -146,6 +146,36 @@ class TestClusterSPI:
         after = np.mean(cn.score_examples(batches))
         assert after < before
 
+    def test_shared_master_update_magnitude_independent_of_workers(self):
+        """Each encoded update must land exactly once per replica — more
+        workers must NOT multiply the effective learning rate."""
+        from deeplearning4j_tpu.scaleout import (SharedTrainingMaster,
+                                                 ClusterMultiLayerNetwork)
+        import jax
+        from jax.flatten_util import ravel_pytree
+        batches = self._batches(n_batches=8)
+        deltas = {}
+        for workers in (1, 4):
+            net = _toy_net()
+            v0, _ = ravel_pytree(net.params)
+            master = SharedTrainingMaster(threshold=1e-3, workers=workers,
+                                          learning_rate=0.05)
+            ClusterMultiLayerNetwork(net, master).fit(batches)
+            v1, _ = ravel_pytree(net.params)
+            deltas[workers] = float(jnp.linalg.norm(v1 - v0))
+        ratio = deltas[4] / deltas[1]
+        assert 0.5 < ratio < 2.0, deltas
+
+    def test_repartition_preserves_masks(self):
+        from deeplearning4j_tpu.scaleout import repartition
+        x = np.random.RandomState(0).randn(10, 5, 3).astype(np.float32)
+        y = np.zeros((10, 5, 2), np.float32)
+        m = (np.arange(5)[None, :] < 3).astype(np.float32).repeat(10, 0)
+        ds = DataSet(x, y, m, m)
+        out = repartition([ds], 4, seed=2)
+        assert all(b.features_mask is not None for b in out)
+        assert sum(b.features.shape[0] for b in out) == 10
+
     def test_repartition(self):
         from deeplearning4j_tpu.scaleout import repartition
         batches = self._batches(n_batches=3, bs=10)   # 30 examples

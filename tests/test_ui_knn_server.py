@@ -1,0 +1,170 @@
+"""Tests: stats storage/listener/UI server + KNN REST service.
+
+Parity patterns: reference ui tests boot PlayUIServer and post stats
+(SURVEY.md §4 'UI tests'), nearestneighbor-server tests hit the REST API
+with real vectors."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import (InMemoryStatsStorage, FileStatsStorage,
+                                   StatsReport, StatsListener, UIServer,
+                                   RemoteUIStatsStorageRouter)
+
+
+def _report(sid="s1", it=0, score=1.0):
+    return StatsReport(session_id=sid, iteration=it, score=score,
+                       timestamp=123.0, iteration_time_ms=5.0,
+                       param_stats={"0": {"mean": 0.1, "std": 0.2,
+                                          "min": -1.0, "max": 1.0,
+                                          "norm": 3.0}})
+
+
+class TestStorage:
+    def test_binary_roundtrip(self):
+        r = _report()
+        r2 = StatsReport.from_bytes(r.to_bytes())
+        assert r2.session_id == "s1" and r2.iteration == 0
+        assert r2.score == 1.0 and r2.param_stats["0"]["norm"] == 3.0
+
+    def test_bad_magic_raises(self):
+        with pytest.raises(ValueError, match="not a StatsReport"):
+            StatsReport.from_bytes(b"XXXX" + b"\x00" * 40)
+
+    def test_in_memory_pubsub(self):
+        st = InMemoryStatsStorage()
+        got = []
+        st.register_stats_listener(got.append)
+        st.put_update(_report(it=1))
+        st.put_update(_report(it=2))
+        assert st.list_session_ids() == ["s1"]
+        assert [r.iteration for r in st.get_all_updates("s1")] == [1, 2]
+        assert st.get_latest_update("s1").iteration == 2
+        assert len(got) == 2
+
+    def test_file_storage_persists_and_reloads(self, tmp_path):
+        p = str(tmp_path / "stats.bin")
+        st = FileStatsStorage(p)
+        st.put_update(_report(it=1, score=2.5))
+        st.put_update(_report(sid="s2", it=7))
+        st.close()
+        st2 = FileStatsStorage(p)
+        assert st2.list_session_ids() == ["s1", "s2"]
+        assert st2.get_latest_update("s1").score == 2.5
+        st2.close()
+
+    def test_file_storage_ignores_truncated_tail(self, tmp_path):
+        p = str(tmp_path / "stats.bin")
+        st = FileStatsStorage(p)
+        st.put_update(_report(it=1))
+        st.close()
+        with open(p, "ab") as fh:            # simulate crash mid-write
+            fh.write(b"\xff\xff\x00\x00partial")
+        st2 = FileStatsStorage(p)
+        assert [r.iteration for r in st2.get_all_updates("s1")] == [1]
+        st2.close()
+
+
+class TestStatsListenerAndServer:
+    def _train_tiny(self, storage):
+        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_in=3, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        lst = StatsListener(storage, session_id="train_sess")
+        net.set_listeners(lst)
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        for _ in range(5):
+            net.fit(x, y)
+        return net
+
+    def test_listener_collects_and_ui_serves(self):
+        storage = InMemoryStatsStorage()
+        self._train_tiny(storage)
+        ups = storage.get_all_updates("train_sess")
+        assert len(ups) == 5
+        assert np.isfinite(ups[-1].score)
+        assert ups[-1].param_stats            # param summaries collected
+        assert ups[1].update_stats            # deltas from 2nd iteration
+        assert storage.get_static_info("train_sess")["numLayers"] == 2
+
+        ui = UIServer(port=0)
+        try:
+            ui.attach(storage)
+            base = f"http://127.0.0.1:{ui.port}"
+            sids = json.loads(urllib.request.urlopen(
+                base + "/train/sessions", timeout=5).read())
+            assert "train_sess" in sids
+            ov = json.loads(urllib.request.urlopen(
+                base + "/train/overview?sid=train_sess", timeout=5).read())
+            assert len(ov["scores"]) == 5
+            assert ov["latestParamStats"]
+            page = urllib.request.urlopen(base + "/", timeout=5).read()
+            assert b"training overview" in page
+            model = json.loads(urllib.request.urlopen(
+                base + "/train/model?sid=train_sess", timeout=5).read())
+            assert model["numLayers"] == 2
+        finally:
+            ui.stop()
+
+    def test_remote_router_round_trip(self):
+        ui = UIServer(port=0)
+        try:
+            remote_storage = ui.enable_remote_listener()
+            router = RemoteUIStatsStorageRouter(
+                f"http://127.0.0.1:{ui.port}")
+            router.put_static_info("remote_sess", {"numLayers": 3})
+            router.put_update(_report(sid="remote_sess", it=9, score=0.5))
+            ups = remote_storage.get_all_updates("remote_sess")
+            assert len(ups) == 1 and ups[0].iteration == 9
+            assert remote_storage.get_static_info(
+                "remote_sess")["numLayers"] == 3
+        finally:
+            ui.stop()
+
+
+class TestKnnServer:
+    def test_server_and_client(self):
+        from deeplearning4j_tpu.clustering.knn_server import (
+            NearestNeighborsServer, NearestNeighborsClient)
+        rs = np.random.RandomState(0)
+        pts = rs.randn(50, 8).astype(np.float32)
+        srv = NearestNeighborsServer(pts, port=0).start()
+        try:
+            cli = NearestNeighborsClient(f"http://127.0.0.1:{srv.port}")
+            # query by corpus index: nearest non-self neighbours
+            res = cli.knn(index=3, k=5)
+            assert len(res) == 5
+            assert all(r["index"] != 3 for r in res)
+            dists = [r["distance"] for r in res]
+            assert dists == sorted(dists)
+            # query by new vector: point 7 itself must come back first
+            res2 = cli.knn_new(pts[7], k=3)
+            assert res2[0][0]["index"] == 7
+            assert res2[0][0]["distance"] < 1e-4
+        finally:
+            srv.stop()
+
+    def test_client_error_propagation(self):
+        from deeplearning4j_tpu.clustering.knn_server import (
+            NearestNeighborsServer, NearestNeighborsClient)
+        srv = NearestNeighborsServer(np.eye(4, dtype=np.float32),
+                                     port=0).start()
+        try:
+            cli = NearestNeighborsClient(f"http://127.0.0.1:{srv.port}")
+            with pytest.raises(RuntimeError):
+                cli.knn(index=999, k=1)      # out of range
+        finally:
+            srv.stop()
