@@ -242,6 +242,45 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, data, epochs: int = 1, lr: float = 0.01):
+        """Greedy unsupervised layerwise pretraining of RBM/AutoEncoder/VAE
+        layers (parity: MultiLayerNetwork.pretrain :1172 — called before
+        supervised fit). ``data``: iterator of DataSets (features used)."""
+        from deeplearning4j_tpu.nn.layers.pretrain import get_pretrain_step
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        for i, layer in enumerate(self.layers):
+            step = get_pretrain_step(layer)
+            if step is None:
+                continue
+            jit_step = jax.jit(step)
+
+            def featurize(x):
+                act, _, _ = self._forward(self.params, self.state,
+                                          jnp.asarray(x), train=False,
+                                          rng=None, upto=i)
+                return act
+
+            feat_fn = jax.jit(featurize)
+            for ep in range(epochs):
+                if hasattr(data, "reset"):
+                    data.reset()
+                for j, ds in enumerate(data if not isinstance(data, DataSet)
+                                       else [data]):
+                    if not isinstance(ds, DataSet):
+                        ds = DataSet(*ds)
+                    x = feat_fn(ds.features)
+                    if x.ndim > 2:
+                        x = x.reshape(x.shape[0], -1)
+                    rng = jax.random.fold_in(
+                        jax.random.PRNGKey(self.conf.global_conf.seed),
+                        i * 100003 + ep * 1009 + j)
+                    self.params[i], loss = jit_step(self.params[i], x, rng,
+                                                    jnp.asarray(lr))
+                    self._score = float(loss)
+        return self
+
     def _fit_tbptt(self, x, y, mf, ml):
         """Truncated BPTT: slice time into tbptt_fwd_length chunks, carrying
         RNN state (no gradient) across chunks (parity:

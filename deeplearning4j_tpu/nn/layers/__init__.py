@@ -26,6 +26,10 @@ from deeplearning4j_tpu.nn.layers.special import (
     GlobalPoolingLayer, AutoEncoder, VariationalAutoencoder,
     CenterLossOutputLayer, Yolo2OutputLayer, FrozenLayer,
 )
+from deeplearning4j_tpu.nn.layers.attention import (
+    MultiHeadAttention, LayerNormalization,
+)
+from deeplearning4j_tpu.nn.layers.pretrain import RBM
 
 __all__ = [
     "Layer", "LAYER_REGISTRY", "layer_from_dict",
@@ -41,4 +45,5 @@ __all__ = [
     "RnnOutputLayer", "RnnLossLayer", "LastTimeStep",
     "GlobalPoolingLayer", "AutoEncoder", "VariationalAutoencoder",
     "CenterLossOutputLayer", "Yolo2OutputLayer", "FrozenLayer",
+    "MultiHeadAttention", "LayerNormalization", "RBM",
 ]

@@ -1,0 +1,129 @@
+"""Unsupervised layerwise pretraining: RBM + the pretrain protocol.
+
+Parity: reference nn/layers/feedforward/rbm/RBM.java (legacy CD-k
+restricted Boltzmann machine), nn/conf/layers/RBM.java, and
+MultiLayerNetwork.pretrain (MultiLayerNetwork.java:1172 — greedy layerwise
+pretraining of RBM/AutoEncoder/VAE layers before supervised backprop).
+
+Protocol: a layer is pretrainable if it defines ``pretrain_step(params, x,
+rng, lr) -> (new_params, loss)``. RBM implements contrastive divergence
+directly (CD is not the gradient of a tractable loss); AutoEncoder gets a
+generic gradient step on its reconstruction ``compute_score``. The whole
+CD-k chain is one jit'd function — Gibbs steps are a ``lax.fori_loop``.
+
+Param keys follow the reference's PretrainParamInitializer: ``W`` (n_in,
+n_out), ``b`` hidden bias, ``vb`` visible bias."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, require_dims
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+@register_layer
+@dataclass
+class RBM(Layer):
+    """Bernoulli-Bernoulli RBM (parity: RBM.java, hidden/visible unit types
+    BINARY; GAUSSIAN visible supported via ``visible_unit='gaussian'``).
+    As a feedforward layer, ``apply`` is propup: sigmoid(x W + b)."""
+    n_in: int = 0
+    n_out: int = 0
+    k: int = 1                      # CD-k Gibbs steps
+    visible_unit: str = "binary"    # binary | gaussian
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, rng, dtype=jnp.float32):
+        require_dims(self, n_in=self.n_in, n_out=self.n_out)
+        return {
+            "W": init_weights(rng, (self.n_in, self.n_out),
+                              self.weight_init or "xavier", self.dist, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),
+        }
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        act = get_activation(self.activation or "sigmoid")
+        return act(x @ params["W"] + params["b"]), state
+
+    # --------------------------------------------------------- pretraining
+    def _prop_up(self, params, v):
+        return jax.nn.sigmoid(v @ params["W"] + params["b"])
+
+    def _prop_down(self, params, h):
+        pre = h @ params["W"].T + params["vb"]
+        if self.visible_unit == "gaussian":
+            return pre
+        return jax.nn.sigmoid(pre)
+
+    def pretrain_step(self, params, x, rng, lr):
+        """One CD-k update on a minibatch. Returns (params, recon_error)."""
+        B = x.shape[0]
+        h0 = self._prop_up(params, x)
+
+        def gibbs(i, carry):
+            h, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            h_samp = jax.random.bernoulli(k1, h).astype(x.dtype)
+            v = self._prop_down(params, h_samp)
+            if self.visible_unit == "binary":
+                v = jax.random.bernoulli(k2, v).astype(x.dtype)
+            return self._prop_up(params, v), key
+
+        hk, _ = lax.fori_loop(0, self.k, gibbs, (h0, rng))
+        # one final deterministic down-up for the negative phase statistics
+        key = jax.random.fold_in(rng, 7)
+        h_samp = jax.random.bernoulli(key, hk).astype(x.dtype)
+        vk = self._prop_down(params, h_samp)
+        hk2 = self._prop_up(params, vk)
+
+        dW = (x.T @ h0 - vk.T @ hk2) / B
+        dvb = (x - vk).mean(axis=0)
+        dhb = (h0 - hk2).mean(axis=0)
+        new_params = {
+            "W": params["W"] + lr * dW,
+            "b": params["b"] + lr * dhb,
+            "vb": params["vb"] + lr * dvb,
+        }
+        recon = jnp.mean((x - self._prop_down(params, h0)) ** 2)
+        return new_params, recon
+
+
+def make_gradient_pretrain_step(layer):
+    """Generic pretrain step for layers with a self-supervised
+    ``compute_score`` (AutoEncoder, VariationalAutoencoder): plain SGD on
+    the layer's own reconstruction/ELBO loss."""
+
+    def step(params, x, rng, lr):
+        def loss_fn(p):
+            return layer.compute_score(p, x, None, None, train=True, rng=rng)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    return step
+
+
+def get_pretrain_step(layer):
+    """Resolve the pretrain function for a layer, or None."""
+    if hasattr(layer, "pretrain_step"):
+        return layer.pretrain_step
+    if type(layer).__name__ in ("AutoEncoder", "VariationalAutoencoder"):
+        return make_gradient_pretrain_step(layer)
+    return None

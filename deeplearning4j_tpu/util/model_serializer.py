@@ -135,7 +135,21 @@ def restore_normalizer(path):
 
 
 def guess_model(path):
-    """Sniff + load either container (parity: core util/ModelGuesser.java)."""
-    with zipfile.ZipFile(path, "r") as z:
-        meta = json.loads(z.read(META_NAME))
-    return _restore(path, True, None)
+    """Sniff + load a model file (parity: core util/ModelGuesser.java):
+    our zip checkpoint (MLN or CG), or a Keras HDF5 file."""
+    with open(path, "rb") as fh:
+        magic = fh.read(8)
+    if magic[:4] == b"PK\x03\x04":          # our zip checkpoint
+        with zipfile.ZipFile(path, "r") as z:
+            if META_NAME not in z.namelist():
+                raise ValueError(
+                    f"{path} is a zip but not a deeplearning4j_tpu "
+                    f"checkpoint (missing {META_NAME})")
+        return _restore(path, True, None)
+    if magic == b"\x89HDF\r\n\x1a\n":       # Keras HDF5
+        from deeplearning4j_tpu.modelimport.keras_import import (
+            import_keras_model_and_weights)
+        return import_keras_model_and_weights(path)
+    raise ValueError(f"cannot identify model format of {path} "
+                     f"(magic {magic!r}); expected checkpoint zip or "
+                     f"Keras HDF5")
