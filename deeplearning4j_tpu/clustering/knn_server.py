@@ -61,6 +61,10 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/knn":            # by index into the corpus
                 idx = int(payload["index"])
                 k = int(payload.get("k", 1))
+                if not 0 <= idx < len(srv.points):
+                    self._json({"error": f"index {idx} out of range "
+                                         f"[0, {len(srv.points)})"}, 400)
+                    return
                 q = srv.points[idx:idx + 1]
                 ids, dists = srv.query(q, k + 1)
                 # drop the query point itself (reference does the same)
@@ -92,9 +96,10 @@ class NearestNeighborsServer:
     """
 
     def __init__(self, points: np.ndarray, port: int = 9200,
-                 use_device: bool = True):
+                 use_device: bool = True, host: str = "127.0.0.1"):
         self.points = np.asarray(points, dtype=np.float32)
         self._port_req = port
+        self._host = host
         self.use_device = use_device
         self._index = None
         self._httpd = None
@@ -126,7 +131,7 @@ class NearestNeighborsServer:
 
     def start(self):
         self._build_index()
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self._port_req),
+        self._httpd = ThreadingHTTPServer((self._host, self._port_req),
                                           _Handler)
         self._httpd.knn = self
         self.port = self._httpd.server_address[1]

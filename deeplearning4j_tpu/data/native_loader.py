@@ -50,7 +50,11 @@ def load_idx_native(img_path: str, lab_path: str, n_classes: int = 10):
 def load_csv_native(path: str, label_col: int = -1, n_classes: int = 0,
                     skip_lines: int = 0, delimiter: str = ","):
     """CSV → (x, y). label_col=-1 → no label column (y empty).
-    Returns None if the native lib is unavailable."""
+    Returns None if the native lib is unavailable.
+
+    Limitations: plain numeric CSV only — quoted fields and embedded
+    delimiters are unsupported; lines over 64 KiB raise (rc=8) instead of
+    silently splitting."""
     lib = native.get_lib()
     if lib is None:
         return None

@@ -132,25 +132,71 @@ class ComputationGraph:
             total = total.astype(jnp.float32)
         return total, new_state
 
+    def _normalize_grads(self, grads):
+        from deeplearning4j_tpu.nn.updaters import normalize_layer_grad
+        gc = self.conf.global_conf
+        kind = gc.gradient_normalization
+        if not kind or kind == "None":
+            return grads
+        thr = gc.gradient_normalization_threshold
+        return {n: normalize_layer_grad(g, kind, thr) for n, g in grads.items()}
+
+    # -------------------------------------------- data-parallel protocol
+    # Same three-method surface as MultiLayerNetwork so ParallelWrapper is
+    # model-agnostic (parity: ParallelWrapper.java:58 takes any Model).
+    def _dp_batch(self, ds):
+        """DataSet/MultiDataSet → (inputs list, labels list, masks dict|None,
+        label_masks list|None)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        if isinstance(ds, DataSet):
+            ds = ds.to_multi()
+        masks = None
+        if ds.features_masks and any(m is not None for m in ds.features_masks):
+            masks = {n: np.asarray(m) for n, m in
+                     zip(self.conf.network_inputs, ds.features_masks)
+                     if m is not None}
+        label_masks = None
+        if ds.labels_masks and any(m is not None for m in ds.labels_masks):
+            label_masks = [None if m is None else np.asarray(m)
+                           for m in ds.labels_masks]
+        return ([np.asarray(f) for f in ds.features],
+                [np.asarray(l) for l in ds.labels], masks, label_masks)
+
+    def _dp_loss(self, params, state, inputs, labels, rng, pad_mask=None,
+                 masks=None, label_masks=None):
+        if pad_mask is not None:
+            pms = [jnp.broadcast_to(pad_mask[:, None], y.shape[:2])
+                   if y.ndim == 3 else pad_mask for y in labels]
+            if label_masks is None:
+                label_masks = pms
+            else:
+                label_masks = [pm if m is None else m * pm
+                               for m, pm in zip(label_masks, pms)]
+        return self._loss(params, state, inputs, labels, rng, masks,
+                          label_masks)
+
+    def _dp_apply_updates(self, params, opt_state, grads):
+        grads = self._normalize_grads(grads)
+        new_params, new_opt = {}, {}
+        for name, p in params.items():
+            if not p:
+                new_params[name], new_opt[name] = p, opt_state[name]
+                continue
+            u, o = self._transforms[name].update(grads[name], opt_state[name], p)
+            np_ = optax.apply_updates(p, u)
+            np_ = self.conf.nodes[name].layer.apply_constraints(np_)
+            new_params[name], new_opt[name] = np_, o
+        return new_params, new_opt
+
     # ----------------------------------------------------------- train step
     def _make_train_step(self):
-        transforms = self._transforms
-
         def step(params, state, opt_state, inputs, labels, it, masks, label_masks):
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.conf.global_conf.seed), it)
             (loss, new_state), grads = jax.value_and_grad(
                 self._loss, has_aux=True)(params, state, inputs, labels, rng,
                                           masks, label_masks)
-            new_params, new_opt = {}, {}
-            for name, p in params.items():
-                if not p:
-                    new_params[name], new_opt[name] = p, opt_state[name]
-                    continue
-                u, o = transforms[name].update(grads[name], opt_state[name], p)
-                np_ = optax.apply_updates(p, u)
-                np_ = self.conf.nodes[name].layer.apply_constraints(np_)
-                new_params[name], new_opt[name] = np_, o
+            new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
             return new_params, new_state, new_opt, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))

@@ -133,60 +133,61 @@ class MultiLayerNetwork:
         return loss, (new_states, new_carries)
 
     def _normalize_grads(self, grads):
+        from deeplearning4j_tpu.nn.updaters import normalize_layer_grad
         gc = self.conf.global_conf
         kind = gc.gradient_normalization
         if not kind or kind == "None":
             return grads
         thr = gc.gradient_normalization_threshold
-        out = []
-        for g in grads:
-            if not g:
-                out.append(g)
+        return [normalize_layer_grad(g, kind, thr) for g in grads]
+
+    # -------------------------------------------- data-parallel protocol
+    # Uniform surface used by parallel.wrapper.ParallelWrapper so the wrapper
+    # is model-agnostic (parity: reference ParallelWrapper.java:58 accepts any
+    # Model). ComputationGraph implements the same three methods.
+    def _dp_batch(self, ds):
+        """DataSet → canonical (x, y, features_mask, labels_mask)."""
+        return (np.asarray(ds.features), np.asarray(ds.labels),
+                None if ds.features_mask is None else np.asarray(ds.features_mask),
+                None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+
+    def _dp_loss(self, params, state, x, y, rng, pad_mask=None, mf=None,
+                 ml=None):
+        """Loss with optional per-example zero-weighting of padded rows,
+        combined with the DataSet's own masks. pad_mask: (B,) float,
+        1=real row / 0=pad. Returns (loss, new_state)."""
+        if pad_mask is not None:
+            pm = (jnp.broadcast_to(pad_mask[:, None], y.shape[:2])
+                  if y.ndim == 3 else pad_mask)
+            ml = pm if ml is None else ml * pm
+        loss, (new_state, _) = self._loss(params, state, x, y, rng, mf, ml)
+        return loss, new_state
+
+    def _dp_apply_updates(self, params, opt_state, grads):
+        """Normalize grads, run updaters, apply constraints — one layer at a
+        time (same math as the single-device train step)."""
+        grads = self._normalize_grads(grads)
+        new_params, new_opt = [], []
+        for i, (l, t) in enumerate(zip(self.layers, self._transforms)):
+            if not params[i]:
+                new_params.append(params[i])
+                new_opt.append(opt_state[i])
                 continue
-            leaves = jax.tree_util.tree_leaves(g)
-            if kind == "ClipElementWiseAbsoluteValue":
-                g = jax.tree_util.tree_map(lambda a: jnp.clip(a, -thr, thr), g)
-            elif kind in ("ClipL2PerLayer", "RenormalizeL2PerLayer"):
-                norm = jnp.sqrt(sum((a ** 2).sum() for a in leaves))
-                if kind == "ClipL2PerLayer":
-                    scale = jnp.minimum(1.0, thr / jnp.maximum(norm, 1e-12))
-                else:
-                    scale = 1.0 / jnp.maximum(norm, 1e-12)
-                g = jax.tree_util.tree_map(lambda a: a * scale, g)
-            elif kind in ("ClipL2PerParamType", "RenormalizeL2PerParamType"):
-                def per_param(a):
-                    n = jnp.sqrt((a ** 2).sum())
-                    if kind == "ClipL2PerParamType":
-                        s = jnp.minimum(1.0, thr / jnp.maximum(n, 1e-12))
-                    else:
-                        s = 1.0 / jnp.maximum(n, 1e-12)
-                    return a * s
-                g = jax.tree_util.tree_map(per_param, g)
-            out.append(g)
-        return out
+            u, o = t.update(grads[i], opt_state[i], params[i])
+            p = optax.apply_updates(params[i], u)
+            new_params.append(l.apply_constraints(p))
+            new_opt.append(o)
+        return new_params, new_opt
 
     # ----------------------------------------------------------- train step
     def _make_train_step(self, with_masks, with_carries):
-        transforms = self._transforms
-
         def step(params, state, opt_state, x, y, it, mask_f, mask_l, carries):
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.conf.global_conf.seed), it)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 self._loss, has_aux=True)(params, state, x, y, rng,
                                           mask_f, mask_l, carries)
-            grads = self._normalize_grads(grads)
-            new_params, new_opt = [], []
-            for i, (l, t) in enumerate(zip(self.layers, transforms)):
-                if not params[i]:
-                    new_params.append(params[i])
-                    new_opt.append(opt_state[i])
-                    continue
-                u, o = t.update(grads[i], opt_state[i], params[i])
-                p = optax.apply_updates(params[i], u)
-                p = l.apply_constraints(p)
-                new_params.append(p)
-                new_opt.append(o)
+            new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
             return new_params, new_state, new_opt, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -250,6 +251,10 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.nn.layers.pretrain import get_pretrain_step
         from deeplearning4j_tpu.data.dataset import DataSet
 
+        # a plain generator would be exhausted after the first (layer, epoch)
+        # pass — materialize anything we can't reset()
+        if not isinstance(data, DataSet) and not hasattr(data, "reset"):
+            data = list(data)
         for i, layer in enumerate(self.layers):
             step = get_pretrain_step(layer)
             if step is None:

@@ -95,6 +95,20 @@ int idx_load(const char* img_path, const char* lab_path, int n_classes,
 // ------------------------------------------------------------------ CSV
 // Two-phase: csv_dims counts rows/cols; csv_load fills x (all non-label
 // columns) and y (label column one-hot to n_classes, or raw if 0).
+// Lines longer than the 64 KiB buffer are an error (rc=8), not a silent
+// row split; quoted fields / embedded delimiters are unsupported (the
+// Python binding documents this).
+static int line_truncated(const char* line, size_t cap, FILE* f) {
+  size_t len = strlen(line);
+  if (len != cap - 1 || line[len - 1] == '\n') return 0;
+  // buffer full without newline: truncated unless this is the final line of
+  // a file with no trailing newline
+  int c = fgetc(f);
+  if (c == EOF) return 0;
+  ungetc(c, f);
+  return 1;
+}
+
 int csv_dims(const char* path, int skip_lines, char delim,
              int64_t* out_rows, int64_t* out_cols) {
   FILE* f = fopen(path, "rb");
@@ -103,6 +117,10 @@ int csv_dims(const char* path, int skip_lines, char delim,
   int64_t rows = 0, cols = 0;
   int skipped = 0;
   while (fgets(line, sizeof(line), f)) {
+    if (line_truncated(line, sizeof(line), f)) {
+      fclose(f);
+      return 8;
+    }
     if (skipped < skip_lines) {
       skipped++;
       continue;
@@ -130,6 +148,10 @@ int csv_load(const char* path, int skip_lines, char delim, int64_t n_cols,
   int64_t row = 0;
   int64_t n_feat = (label_col >= 0) ? n_cols - 1 : n_cols;
   while (fgets(line, sizeof(line), f)) {
+    if (line_truncated(line, sizeof(line), f)) {
+      fclose(f);
+      return 8;
+    }
     if (skipped < skip_lines) {
       skipped++;
       continue;

@@ -84,8 +84,9 @@ class RBM(Layer):
                 v = jax.random.bernoulli(k2, v).astype(x.dtype)
             return self._prop_up(params, v), key
 
-        hk, _ = lax.fori_loop(0, self.k, gibbs, (h0, rng))
-        # one final deterministic down-up for the negative phase statistics
+        # k-1 full Gibbs steps; the final sample/down/up below is the k-th,
+        # so CD-k runs exactly k steps (parity: RBM.java CD-k)
+        hk, _ = lax.fori_loop(0, self.k - 1, gibbs, (h0, rng))
         key = jax.random.fold_in(rng, 7)
         h_samp = jax.random.bernoulli(key, hk).astype(x.dtype)
         vk = self._prop_down(params, h_samp)

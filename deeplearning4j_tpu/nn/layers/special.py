@@ -225,7 +225,11 @@ class VariationalAutoencoder(Layer):
         else:  # gaussian / mse
             rec = 0.5 * ((x - logits) ** 2).sum(axis=-1)
         kl = -0.5 * (1 + logvar - mean ** 2 - jnp.exp(logvar)).sum(axis=-1)
-        return (rec + kl).mean()
+        per_ex = rec + kl
+        if mask is not None:
+            m = mask.reshape(per_ex.shape[0])
+            return (per_ex * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return per_ex.mean()
 
 
 @register_layer
@@ -248,7 +252,12 @@ class CenterLossOutputLayer(OutputLayer):
             x, labels, mask, train=train, rng=rng)
         cls = jnp.argmax(labels, axis=-1)
         centers = params["centers"][cls]
-        cl = 0.5 * ((x - centers) ** 2).sum(axis=-1).mean()
+        per_ex = 0.5 * ((x - centers) ** 2).sum(axis=-1)
+        if mask is not None:
+            m = mask.reshape(per_ex.shape[0])
+            cl = (per_ex * m).sum() / jnp.maximum(m.sum(), 1.0)
+        else:
+            cl = per_ex.mean()
         return base + self.lambda_ * cl
 
 
@@ -291,13 +300,18 @@ class Yolo2OutputLayer(Layer):
         lab = labels.reshape(B, H, W, A, 5 + self.n_classes)
         txy, twh, tobj, tcls = lab[..., 0:2], lab[..., 2:4], lab[..., 4], lab[..., 5:]
         coord = ((pxy - txy) ** 2).sum(-1) + ((pwh - twh) ** 2).sum(-1)
-        coord = (coord * tobj).sum() / B
-        obj_loss = (tobj * (pobj - 1.0) ** 2).sum() / B
-        noobj_loss = ((1 - tobj) * pobj ** 2).sum() / B
+        # per-example terms (B,), then mask-weighted mean over examples
+        coord = (coord * tobj).sum((1, 2, 3))
+        obj_loss = (tobj * (pobj - 1.0) ** 2).sum((1, 2, 3))
+        noobj_loss = ((1 - tobj) * pobj ** 2).sum((1, 2, 3))
         logp = jax.nn.log_softmax(pcls, axis=-1)
-        cls_loss = (-(tcls * logp).sum(-1) * tobj).sum() / B
-        return (self.lambda_coord * coord + obj_loss +
-                self.lambda_no_obj * noobj_loss + cls_loss)
+        cls_loss = ((-(tcls * logp).sum(-1)) * tobj).sum((1, 2, 3))
+        per_ex = (self.lambda_coord * coord + obj_loss +
+                  self.lambda_no_obj * noobj_loss + cls_loss)
+        if mask is not None:
+            m = mask.reshape(B)
+            return (per_ex * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return per_ex.sum() / B
 
 
 @register_layer

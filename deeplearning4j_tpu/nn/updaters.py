@@ -222,3 +222,34 @@ def make_gradient_transform(updater: Updater,
         chain.append(optax.clip_by_global_norm(grad_norm_threshold))
     chain.append(updater.to_optax())
     return optax.chain(*chain) if len(chain) > 1 else chain[0]
+
+
+def normalize_layer_grad(g, kind: Optional[str], thr: float):
+    """Gradient normalization for ONE layer's gradient pytree (parity:
+    GradientNormalization, nn/conf/GradientNormalization.java, applied per
+    layer in BaseLayer.update). Shared by MultiLayerNetwork and
+    ComputationGraph containers."""
+    import jax
+    import jax.numpy as jnp
+    if not g or not kind or kind == "None":
+        return g
+    leaves = jax.tree_util.tree_leaves(g)
+    if kind == "ClipElementWiseAbsoluteValue":
+        return jax.tree_util.tree_map(lambda a: jnp.clip(a, -thr, thr), g)
+    if kind in ("ClipL2PerLayer", "RenormalizeL2PerLayer"):
+        norm = jnp.sqrt(sum((a ** 2).sum() for a in leaves))
+        if kind == "ClipL2PerLayer":
+            scale = jnp.minimum(1.0, thr / jnp.maximum(norm, 1e-12))
+        else:
+            scale = 1.0 / jnp.maximum(norm, 1e-12)
+        return jax.tree_util.tree_map(lambda a: a * scale, g)
+    if kind in ("ClipL2PerParamType", "RenormalizeL2PerParamType"):
+        def per_param(a):
+            n = jnp.sqrt((a ** 2).sum())
+            if kind == "ClipL2PerParamType":
+                s = jnp.minimum(1.0, thr / jnp.maximum(n, 1e-12))
+            else:
+                s = 1.0 / jnp.maximum(n, 1e-12)
+            return a * s
+        return jax.tree_util.tree_map(per_param, g)
+    return g

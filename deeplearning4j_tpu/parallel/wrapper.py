@@ -5,7 +5,10 @@ parallelwrapper/.../ParallelWrapper.java:58 — N replicas, synchronous param
 averaging every ``averagingFrequency`` iterations :251-371, or async
 threshold-encoded gradient sharing via EncodedGradientsAccumulator) and the
 Spark ParameterAveragingTrainingMaster / SharedTrainingMaster stacks
-(SURVEY.md §2 #19/#22/#23).
+(SURVEY.md §2 #19/#22/#23). Like the reference (which takes any ``Model``),
+this wrapper accepts either container — MultiLayerNetwork or
+ComputationGraph — through the uniform ``_dp_batch`` / ``_dp_loss`` /
+``_dp_apply_updates`` protocol both implement.
 
 TPU-native design: there are no worker threads, no parameter server, no
 gradient quantization — one jit'd SPMD train step over a
@@ -23,6 +26,11 @@ semantics: each device takes k independent local steps on its own params
 (shard_map + lax.scan over microbatches), then params AND updater state are
 pmean-averaged (parity: averageUpdatersState ParallelWrapper.java:339).
 
+Uneven batches are padded to a device multiple by duplicating rows, but the
+pad rows carry a zero loss-weight (a per-example mask through the model's
+mask-aware losses), so gradients equal the unpadded batch exactly — no
+double-counting.
+
 Multi-host: the same code scales over DCN by initializing
 ``jax.distributed`` (see deeplearning4j_tpu.parallel.distributed) — the mesh
 then spans all hosts' devices and the collectives ride ICI within a pod and
@@ -37,7 +45,6 @@ from typing import Optional, List
 import numpy as np
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
@@ -45,7 +52,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 
 
 def default_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
@@ -89,29 +96,21 @@ class ParallelWrapper:
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
         data_sh = NamedSharding(mesh, P("data"))
-        transforms = model._transforms
 
-        def step(params, state, opt_state, x, y, it):
+        def step(params, state, opt_state, x, y, it, pad_mask, mf, ml):
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(model.conf.global_conf.seed), it)
-            (loss, (new_state, _)), grads = jax.value_and_grad(
-                model._loss, has_aux=True)(params, state, x, y, rng, None, None)
-            grads = model._normalize_grads(grads)
-            new_params, new_opt = [], []
-            for i, (l, t) in enumerate(zip(model.layers, transforms)):
-                if not params[i]:
-                    new_params.append(params[i])
-                    new_opt.append(opt_state[i])
-                    continue
-                u, o = t.update(grads[i], opt_state[i], params[i])
-                p = optax.apply_updates(params[i], u)
-                new_params.append(l.apply_constraints(p))
-                new_opt.append(o)
+            (loss, new_state), grads = jax.value_and_grad(
+                model._dp_loss, has_aux=True)(params, state, x, y, rng,
+                                              pad_mask, mf, ml)
+            new_params, new_opt = model._dp_apply_updates(params, opt_state,
+                                                          grads)
             return new_params, new_state, new_opt, loss
 
         return jax.jit(
             step,
-            in_shardings=(repl, repl, repl, data_sh, data_sh, None),
+            in_shardings=(repl, repl, repl, data_sh, data_sh, None, data_sh,
+                          data_sh, data_sh),
             out_shardings=(repl, repl, repl, repl),
             donate_argnums=(0, 1, 2))
 
@@ -121,43 +120,35 @@ class ParallelWrapper:
         (parity: ParallelWrapper averaging + averageUpdatersState)."""
         model = self.model
         mesh = self.mesh
-        transforms = model._transforms
-        k = self.averaging_frequency
 
-        def local_update(params, state, opt_state, x, y, rng):
-            (loss, (new_state, _)), grads = jax.value_and_grad(
-                model._loss, has_aux=True)(params, state, x, y, rng, None, None)
-            grads = model._normalize_grads(grads)
-            new_params, new_opt = [], []
-            for i, (l, t) in enumerate(zip(model.layers, transforms)):
-                if not params[i]:
-                    new_params.append(params[i])
-                    new_opt.append(opt_state[i])
-                    continue
-                u, o = t.update(grads[i], opt_state[i], params[i])
-                p = optax.apply_updates(params[i], u)
-                new_params.append(l.apply_constraints(p))
-                new_opt.append(o)
+        def local_update(params, state, opt_state, x, y, pad_mask, rng):
+            (loss, new_state), grads = jax.value_and_grad(
+                model._dp_loss, has_aux=True)(params, state, x, y, rng,
+                                              pad_mask)
+            new_params, new_opt = model._dp_apply_updates(params, opt_state,
+                                                          grads)
             return new_params, new_state, new_opt, loss
 
         @partial(shard_map, mesh=mesh,
-                 in_specs=(P(), P(), P(), P(None, "data"), P(None, "data"), P()),
+                 in_specs=(P(), P(), P(), P(None, "data"), P(None, "data"),
+                           P(None, "data"), P()),
                  out_specs=(P(), P(), P(), P()),
                  check_vma=False)
-        def step(params, state, opt_state, xs, ys, it):
-            # xs: (k, local_batch, ...) after the leading microbatch axis;
-            # batch axis is sharded over 'data'
+        def step(params, state, opt_state, xs, ys, pad_masks, it):
+            # xs leaves: (k, local_batch, ...) — microbatch axis leading,
+            # batch axis sharded over 'data'
             def body(carry, inp):
                 params, state, opt_state, j = carry
-                x, y = inp
+                x, y, pm = inp
                 rng = jax.random.fold_in(
                     jax.random.PRNGKey(model.conf.global_conf.seed), it + j)
                 rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
-                p, s, o, loss = local_update(params, state, opt_state, x, y, rng)
+                p, s, o, loss = local_update(params, state, opt_state, x, y,
+                                             pm, rng)
                 return (p, s, o, j + 1), loss
 
             (params, state, opt_state, _), losses = jax.lax.scan(
-                body, (params, state, opt_state, 0), (xs, ys))
+                body, (params, state, opt_state, 0), (xs, ys, pad_masks))
             # average divergent replicas (params + updater state + bn stats)
             params = jax.lax.pmean(params, "data")
             state = jax.lax.pmean(state, "data")
@@ -183,12 +174,11 @@ class ParallelWrapper:
                 if hasattr(data, "reset"):
                     data.reset()
                 for ds in data:
-                    if not isinstance(ds, DataSet):
-                        ds = DataSet(*ds)
-                    x, y = self._pad_to_devices(ds)
+                    x, y, pad_mask, mf, ml = self._prepare(ds)
                     model.params, model.state, model.opt_state, loss = \
                         self._step_fn(model.params, model.state, model.opt_state,
-                                      x, y, jnp.asarray(model.iteration, jnp.int32))
+                                      x, y, jnp.asarray(model.iteration, jnp.int32),
+                                      pad_mask, mf, ml)
                     model._score = float(loss)
                     model.iteration += 1
                     for lst in model.listeners:
@@ -201,10 +191,8 @@ class ParallelWrapper:
             for _ in range(epochs):
                 if hasattr(data, "reset"):
                     data.reset()
-                micro: List[DataSet] = []
+                micro = []
                 for ds in data:
-                    if not isinstance(ds, DataSet):
-                        ds = DataSet(*ds)
                     micro.append(ds)
                     if len(micro) == k:
                         self._fit_avg_chunk(micro)
@@ -214,36 +202,73 @@ class ParallelWrapper:
                 model.epoch += 1
         return model
 
-    def _fit_avg_chunk(self, micro: List[DataSet]):
+    def _prepare(self, ds):
+        """DataSet → numpy (x, y, pad_mask, mf, ml) padded to a device
+        multiple; pad rows get zero loss-weight. The DataSet's own masks are
+        carried through (combined with the pad mask inside ``_dp_loss``)."""
+        if not isinstance(ds, (DataSet, MultiDataSet)):
+            ds = DataSet(*ds)
+        x, y, mf, ml = self.model._dp_batch(ds)
+        b = jax.tree_util.tree_leaves(x)[0].shape[0]
+        pad_mask = np.ones((b,), np.float32)
+        if b % self.n_devices != 0:
+            pad = self.n_devices - (b % self.n_devices)
+            x = jax.tree_util.tree_map(self._pad_rows, x)
+            y = jax.tree_util.tree_map(self._pad_rows, y)
+            mf = jax.tree_util.tree_map(self._pad_rows, mf)
+            ml = jax.tree_util.tree_map(self._pad_rows, ml)
+            pad_mask = np.concatenate([pad_mask, np.zeros((pad,), np.float32)])
+        return x, y, pad_mask, mf, ml
+
+    def _fit_avg_chunk(self, micro: List):
         model = self.model
         # microbatches may differ in size (last batch of an epoch): pad each
-        # to the chunk max by wrapping, then to a device multiple
-        max_b = max(d.features.shape[0] for d in micro)
+        # to the chunk max by wrapping (zero loss-weight), then to a device
+        # multiple
+        prepared = [self._prepare(ds) for ds in micro]
+        if any(p[3] is not None or p[4] is not None for p in prepared):
+            raise NotImplementedError(
+                "averaging_frequency > 1 does not support per-example masks; "
+                "use averaging_frequency=1 (sync gradient allreduce), which "
+                "handles masked data exactly")
+        max_b = max(jax.tree_util.tree_leaves(p[0])[0].shape[0]
+                    for p in prepared)
 
-        def pad_to(arr, b):
-            while arr.shape[0] < b:
-                arr = np.concatenate([arr, arr[:b - arr.shape[0]]])
-            return self._pad_batch(arr)
+        def widen(arr, m):
+            arr = np.asarray(arr)
+            b = arr.shape[0]
+            if b >= m:
+                return arr
+            idx = np.arange(m - b) % b  # wrap rows; mask zero-weights them
+            return np.concatenate([arr, arr[idx]])
 
-        xs = jnp.stack([jnp.asarray(pad_to(d.features, max_b)) for d in micro])
-        ys = jnp.stack([jnp.asarray(pad_to(d.labels, max_b)) for d in micro])
+        xs, ys, pms = [], [], []
+        for x, y, pm, _, _ in prepared:
+            b = pm.shape[0]
+            if b < max_b:
+                x = jax.tree_util.tree_map(lambda a: widen(a, max_b), x)
+                y = jax.tree_util.tree_map(lambda a: widen(a, max_b), y)
+                pm = np.concatenate([pm, np.zeros((max_b - b,), np.float32)])
+            xs.append(x)
+            ys.append(y)
+            pms.append(pm)
+        xs = jax.tree_util.tree_map(lambda *a: np.stack(a), *xs)
+        ys = jax.tree_util.tree_map(lambda *a: np.stack(a), *ys)
+        pms = np.stack(pms)
         model.params, model.state, model.opt_state, loss = self._step_fn(
-            model.params, model.state, model.opt_state, xs, ys,
+            model.params, model.state, model.opt_state, xs, ys, pms,
             jnp.asarray(model.iteration, jnp.int32))
         model._score = float(loss)
         model.iteration += len(micro)
         for lst in model.listeners:
             lst.iteration_done(model, model.iteration, model.epoch)
 
-    def _pad_batch(self, arr):
+    def _pad_rows(self, arr):
         n = self.n_devices
+        arr = np.asarray(arr)
         b = arr.shape[0]
         if b % n == 0:
             return arr
         pad = n - (b % n)
-        reps = np.concatenate([arr, arr[:pad]])
-        return reps
-
-    def _pad_to_devices(self, ds: DataSet):
-        return (jnp.asarray(self._pad_batch(ds.features)),
-                jnp.asarray(self._pad_batch(ds.labels)))
+        idx = np.arange(pad) % b  # wrap rows; pad_mask zero-weights them
+        return np.concatenate([arr, arr[idx]])

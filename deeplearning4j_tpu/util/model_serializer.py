@@ -136,9 +136,16 @@ def restore_normalizer(path):
 
 def guess_model(path):
     """Sniff + load a model file (parity: core util/ModelGuesser.java):
-    our zip checkpoint (MLN or CG), or a Keras HDF5 file."""
-    with open(path, "rb") as fh:
-        magic = fh.read(8)
+    our zip checkpoint (MLN or CG), or a Keras HDF5 file. ``path`` may be a
+    filesystem path or a seekable file-like object (e.g. the BytesIO held by
+    InMemoryModelSaver)."""
+    if hasattr(path, "read") and hasattr(path, "seek"):
+        path.seek(0)
+        magic = path.read(8)
+        path.seek(0)
+    else:
+        with open(path, "rb") as fh:
+            magic = fh.read(8)
     if magic[:4] == b"PK\x03\x04":          # our zip checkpoint
         with zipfile.ZipFile(path, "r") as z:
             if META_NAME not in z.namelist():
