@@ -1,71 +1,302 @@
-"""Benchmark: LeNet-MNIST training throughput on one TPU chip.
+"""Benchmarks: all five driver BASELINE configs on the attached chip.
 
-BASELINE config #1 (driver BASELINE.json): "MultiLayerNetwork LeNet on MNIST".
-The reference publishes no numbers (SURVEY.md §6), so ``vs_baseline`` is
-computed against a fixed reference point measured from the reference's own
-stack class: DL4J 0.9.2 LeNet on MNIST with the CPU ND4J backend trains at
-roughly 250-350 imgs/sec on a modern 8-core host (its cuDNN path on one V100
-reaches ~2-3k imgs/sec). We use 3000 imgs/sec — the upper end of the
-reference's GPU-accelerated throughput — as the bar to beat.
+BASELINE.md configs (the reference publishes no numbers in-repo — SURVEY.md
+§6 — so each ``vs_baseline`` is computed against a documented ballpark of the
+reference's own GPU-accelerated stack, stated per-bench below):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+1. LeNet on MNIST (MultiLayerNetwork)            — imgs/sec
+2. ResNet50 + VGG16 on CIFAR-10 (zoo)            — imgs/sec (+ MFU estimate)
+3. LSTM char-RNN (fused Pallas kernel vs scan)   — chars/sec + fused speedup
+4. ParallelWrapper data-parallel LeNet           — imgs/sec over the mesh
+5. Word2Vec skip-gram (negative sampling)        — words/sec
+
+Timing notes: this environment attaches the TPU through a tunnel where
+``jax.block_until_ready`` does NOT await dispatch and a device→host read is a
+~100 ms RPC; all measurements therefore chain state across steps and
+difference away the fixed read cost (see deeplearning4j_tpu/util/timing.py).
+
+Prints ONE JSON line per metric:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
 
 import numpy as np
 
-REFERENCE_IMGS_PER_SEC = 3000.0  # DL4J-cuDNN-on-V100 ballpark, the bar to beat
-BATCH = 128
-WARMUP_STEPS = 3
-MEASURE_STEPS = 30
+# Documented reference ballparks (the bars to beat). DL4J 0.9.2 publishes no
+# numbers; these are the upper end of its cuDNN-on-one-V100-class throughput
+# for each config, estimated from the reference's architecture (all-f32,
+# cuDNN 6/7 era kernels) — deliberately generous to the reference.
+BARS = {
+    "lenet": 3000.0,          # imgs/sec, LeNet-MNIST batch 128
+    "resnet50": 600.0,        # imgs/sec, ResNet50 CIFAR-10 batch 128
+    "vgg16": 400.0,           # imgs/sec, VGG16 CIFAR-10 batch 128
+    "charrnn": 200_000.0,     # chars/sec, 2xLSTM(256) char-RNN (cuDNN fused)
+    "pw_lenet": 3000.0,       # imgs/sec per device through ParallelWrapper
+    "word2vec": 500_000.0,    # words/sec, multithreaded JVM skip-gram
+}
+
+V5E_PEAK_FLOPS = 197e12       # bf16 MXU peak of one v5e chip (MFU denominator)
 
 
-def main():
-    from __graft_entry__ import _lenet_conf, _force_cpu_if_requested
-    _force_cpu_if_requested()
-    import jax
+def _emit(metric, value, unit, bar, extra=None):
+    line = {"metric": metric, "value": round(float(value), 1), "unit": unit,
+            "vs_baseline": round(float(value) / bar, 3)}
+    if extra:
+        line.update(extra)
+    print(json.dumps(line), flush=True)
+    return line
+
+
+def _mfu(step_flops, steps_per_sec):
+    if not step_flops:
+        return None
+    return round(step_flops * steps_per_sec / V5E_PEAK_FLOPS, 4)
+
+
+def _cost_flops(jitted, *args):
+    """FLOPs per execution from XLA's cost analysis (None if unavailable)."""
+    try:
+        an = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(an, (list, tuple)):
+            an = an[0]
+        return float(an["flops"])
+    except Exception:
+        return None
+
+
+def _tile_steps(a, k):
     import jax.numpy as jnp
+    return jnp.tile(a[None], (k,) + (1,) * a.ndim)
+
+
+def _time_fit_scan(model, x, y, k=64, repeats=5):
+    """Seconds per train step via the device-resident fit_scan path: k steps
+    run inside ONE compiled call; the fixed dispatch+read cost is removed by
+    differencing a k-step run against a k/8-step run. The host-read RPC's
+    latency is bimodal here, so the representative value is the MEDIAN of
+    ``repeats`` runs (min would pick the rare fast-path outlier)."""
+    import statistics
+    from deeplearning4j_tpu.util.timing import host_sync
+
+    def run(xs, ys):
+        model.fit_scan(xs, ys)
+        host_sync(model._score)                 # compile + warm
+        ts = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            model.fit_scan(xs, ys)
+            host_sync(model._score)
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    k1 = max(1, k // 8)              # both runs multi-step: the differencing
+    x1, y1 = _tile_steps(x, k1), _tile_steps(y, k1)   # baseline is then well
+    xk, yk = _tile_steps(x, k), _tile_steps(y, k)     # above RPC jitter
+    t1 = run(x1, y1)
+    tk = run(xk, yk)
+    sec = max(tk - t1, 1e-9) / (k - k1)
+    flops = None
+    try:
+        import jax.numpy as jnp
+        # XLA cost analysis counts a lax.scan body ONCE regardless of trip
+        # count, so lowering the 1-step program gives per-step FLOPs.
+        flops = _cost_flops(model._scan_fit, model.params, model.state,
+                            model.opt_state,
+                            x1 if isinstance(model.params, list) else [x1],
+                            y1 if isinstance(model.params, list) else [y1],
+                            jnp.asarray(0, jnp.int32))
+    except Exception:
+        pass
+    return sec, flops
+
+
+# ------------------------------------------------------------------ benches
+
+def bench_lenet(batch=128):
+    import jax.numpy as jnp
+    from __graft_entry__ import _lenet_conf
     from deeplearning4j_tpu import MultiLayerNetwork
     from deeplearning4j_tpu.data.fetchers import load_mnist
 
-    dev = jax.devices()[0]
     net = MultiLayerNetwork(_lenet_conf()).init()
+    x_all, y_all = load_mnist(train=True, num_examples=batch, flatten=False)
+    x, y = jnp.asarray(x_all), jnp.asarray(y_all)
+    sec, flops = _time_fit_scan(net, x, y, k=256)
+    ips = batch / sec
+    return _emit(f"LeNet-MNIST train (batch={batch}, 1 chip, fit_scan)", ips,
+                 "imgs/sec", BARS["lenet"],
+                 {"mfu": _mfu(flops, 1.0 / sec)})
 
-    x_all, y_all = load_mnist(train=True, num_examples=BATCH * 4, flatten=False)
-    x = jnp.asarray(x_all[:BATCH])
-    y = jnp.asarray(y_all[:BATCH])
 
-    step = net._get_train_step(False, False)
-    params, state, opt = net.params, net.state, net.opt_state
+def bench_resnet50(batch=128):
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+    from deeplearning4j_tpu.data.fetchers import load_cifar10
 
-    # warmup / compile
-    for i in range(WARMUP_STEPS):
-        params, state, opt, loss, _ = step(params, state, opt, x, y,
-                                           jnp.asarray(i, jnp.int32), None,
-                                           None, None)
-    jax.block_until_ready(loss)
+    cg = ResNet50(num_classes=10, input_shape=(32, 32, 3), seed=7).init()
+    x_all, y_all = load_cifar10(train=True, num_examples=batch)
+    x, y = jnp.asarray(x_all), jnp.asarray(y_all)
+    sec, flops = _time_fit_scan(cg, x, y, k=64)
+    ips = batch / sec
+    return _emit(f"ResNet50-CIFAR10 train (batch={batch}, 1 chip, fit_scan)",
+                 ips, "imgs/sec", BARS["resnet50"],
+                 {"mfu": _mfu(flops, 1.0 / sec)})
 
+
+def bench_vgg16(batch=128):
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo.simple import VGG16
+    from deeplearning4j_tpu.data.fetchers import load_cifar10
+
+    net = VGG16(num_classes=10, input_shape=(32, 32, 3), seed=7).init()
+    x_all, y_all = load_cifar10(train=True, num_examples=batch)
+    x, y = jnp.asarray(x_all), jnp.asarray(y_all)
+    sec, flops = _time_fit_scan(net, x, y, k=64)
+    ips = batch / sec
+    return _emit(f"VGG16-CIFAR10 train (batch={batch}, 1 chip, fit_scan)",
+                 ips, "imgs/sec", BARS["vgg16"],
+                 {"mfu": _mfu(flops, 1.0 / sec)})
+
+
+def bench_charrnn(batch=32, seq_len=64, vocab=77):
+    """Char-RNN (TextGenerationLSTM architecture: 2xLSTM(256) + RnnOutput).
+    The LSTM layer routes through the fused Pallas sequence kernel when
+    helpers are enabled (auto on TPU) — this is the CudnnLSTMHelper-parity
+    proof: fused-vs-scan speedup measured compiled on the chip."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import ops
+    from deeplearning4j_tpu.zoo.simple import TextGenerationLSTM
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, size=(batch, seq_len))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        np.roll(ids, -1, axis=1)])
+
+    def measure():
+        net = TextGenerationLSTM(total_unique_characters=vocab).init()
+        sec, flops = _time_fit_scan(net, x, y, k=64)
+        return sec, flops
+
+    ops.set_helpers_enabled(True)      # fused Pallas kernel
+    sec_fused, flops = measure()
+    ops.set_helpers_enabled(False)     # pure lax.scan path
+    sec_scan, _ = measure()
+    ops.set_helpers_enabled(None)
+
+    cps = batch * seq_len / sec_fused
+    return _emit(
+        f"charRNN-LSTM train (batch={batch}, T={seq_len}, fused kernel)",
+        cps, "chars/sec", BARS["charrnn"],
+        {"fused_vs_scan_speedup": round(sec_scan / sec_fused, 3),
+         "scan_chars_per_sec": round(batch * seq_len / sec_scan, 1),
+         "mfu": _mfu(flops, 1.0 / sec_fused)})
+
+
+def bench_parallel_wrapper(batch_per_dev=128):
+    """Data-parallel LeNet through ParallelWrapper over all attached devices
+    (the driver attaches ONE chip, so this measures the sharded-step path at
+    n=1; multi-device scaling is exercised on the 8-CPU virtual mesh in CI
+    and by __graft_entry__.dryrun_multichip)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from __graft_entry__ import _lenet_conf
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    from deeplearning4j_tpu.util.timing import time_python_loop, host_sync
+    from deeplearning4j_tpu.data.fetchers import load_mnist
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("data",))
+    net = MultiLayerNetwork(_lenet_conf()).init()
+    pw = ParallelWrapper(net, mesh=mesh, averaging_frequency=1)
+
+    batch = batch_per_dev * n
+    x_all, y_all = load_mnist(train=True, num_examples=batch, flatten=False)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    ds = DataSet(x_all, y_all)
+    pw.fit(ListDataSetIterator(ds, batch))     # warm: build + replicate
+    x, y, pad_mask, mf, ml = pw._prepare(ds)
+    step = pw._step_fn
+    st = {"p": net.params, "s": net.state, "o": net.opt_state, "loss": None}
+
+    def one(i):
+        st["p"], st["s"], st["o"], st["loss"] = step(
+            st["p"], st["s"], st["o"], x, y, jnp.asarray(i, jnp.int32),
+            pad_mask, mf, ml)
+
+    sec = time_python_loop(one, 20, lambda: host_sync(st["loss"]))
+    ips = batch / sec
+    return _emit(
+        f"ParallelWrapper LeNet DP (devices={n}, batch/dev={batch_per_dev})",
+        ips, "imgs/sec", BARS["pw_lenet"] * n)
+
+
+def bench_word2vec(n_tokens=200_000, vocab=2000, dim=100):
+    """Skip-gram negative sampling, end-to-end fit on a synthetic Zipf corpus
+    (vocab build excluded; pair generation + device steps included — the
+    same span the reference's words/sec covers)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    rs = np.random.RandomState(5)
+    freq = (1.0 / np.arange(1, vocab + 1)) ** 1.05
+    freq /= freq.sum()
+    toks = rs.choice(vocab, size=n_tokens, p=freq)
+    sents, cur = [], []
+    for t in toks:
+        cur.append(f"w{t}")
+        if len(cur) >= 20:
+            sents.append(" ".join(cur))
+            cur = []
+    w2v = Word2Vec(min_word_frequency=1, layer_size=dim, window_size=5,
+                   negative=5, epochs=1, batch_size=8192, subsampling=1e-3,
+                   sentences=sents, seed=1)
+    w2v.build_vocab()
     t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        params, state, opt, loss, _ = step(params, state, opt, x, y,
-                                           jnp.asarray(i, jnp.int32), None,
-                                           None, None)
-    jax.block_until_ready(loss)
+    w2v.fit()
     dt = time.perf_counter() - t0
+    wps = n_tokens / dt
+    return _emit(f"Word2Vec skip-gram NEG (tokens={n_tokens}, dim={dim})",
+                 wps, "words/sec", BARS["word2vec"])
 
-    imgs_per_sec = MEASURE_STEPS * BATCH / dt
-    print(json.dumps({
-        "metric": "LeNet-MNIST train throughput (batch=128, 1 chip: "
-                  f"{dev.device_kind})",
-        "value": round(imgs_per_sec, 1),
-        "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / REFERENCE_IMGS_PER_SEC, 3),
-    }))
+
+BENCHES = {
+    "lenet": bench_lenet,
+    "resnet50": bench_resnet50,
+    "vgg16": bench_vgg16,
+    "charrnn": bench_charrnn,
+    "parallelwrapper": bench_parallel_wrapper,
+    "word2vec": bench_word2vec,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", nargs="*", choices=sorted(BENCHES),
+                    help="run a subset")
+    a = ap.parse_args(argv)
+    from __graft_entry__ import _force_cpu_if_requested
+    _force_cpu_if_requested()
+    names = a.only or list(BENCHES)
+    failures = 0
+    for name in names:
+        try:
+            BENCHES[name]()
+        except Exception as e:  # noqa: BLE001 — one bench must not kill the rest
+            failures += 1
+            print(json.dumps({"metric": name, "error":
+                              f"{type(e).__name__}: {e}"[:300]}),
+                  file=sys.stderr, flush=True)
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

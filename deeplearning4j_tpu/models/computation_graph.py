@@ -41,6 +41,7 @@ class ComputationGraph:
         self.epoch = 0
         self._score = float("nan")
         self._train_step_cache = {}
+        self._scan_fit = None
         self._output_fn = None
         self._transforms = None
 
@@ -73,6 +74,7 @@ class ComputationGraph:
         self.opt_state = {n: t.init(self.params[n])
                           for n, t in self._transforms.items()}
         self._train_step_cache = {}
+        self._scan_fit = None
         self._output_fn = None
 
     def set_listeners(self, *listeners):
@@ -202,6 +204,46 @@ class ComputationGraph:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------- fit
+    def fit_scan(self, inputs_steps, labels_steps):
+        """Device-resident training: ``n`` train steps in ONE compiled call
+        via lax.scan over a leading step axis (see
+        MultiLayerNetwork.fit_scan). ``inputs_steps``/``labels_steps``:
+        lists of arrays shaped (n_steps, batch, ...) — or single arrays for
+        single-input/-output graphs."""
+        if not isinstance(inputs_steps, (list, tuple)):
+            inputs_steps = [inputs_steps]
+        if not isinstance(labels_steps, (list, tuple)):
+            labels_steps = [labels_steps]
+        inputs_steps = [jnp.asarray(a) for a in inputs_steps]
+        labels_steps = [jnp.asarray(a) for a in labels_steps]
+        if self._scan_fit is None:
+            def inner(params, state, opt_state, xs, ys, it0):
+                def body(carry, inp):
+                    params, state, opt_state, it = carry
+                    x, y = inp
+                    rng = jax.random.fold_in(
+                        jax.random.PRNGKey(self.conf.global_conf.seed), it)
+                    (loss, new_state), grads = jax.value_and_grad(
+                        self._loss, has_aux=True)(params, state, x, y, rng,
+                                                  None, None)
+                    params, opt_state = self._dp_apply_updates(
+                        params, opt_state, grads)
+                    return (params, new_state, opt_state, it + 1), loss
+
+                (p, s, o, _), losses = jax.lax.scan(
+                    body, (params, state, opt_state, it0), (xs, ys))
+                return p, s, o, losses
+
+            self._scan_fit = jax.jit(inner, donate_argnums=(0, 1, 2))
+        self.params, self.state, self.opt_state, losses = self._scan_fit(
+            self.params, self.state, self.opt_state, inputs_steps,
+            labels_steps, jnp.asarray(self.iteration, jnp.int32))
+        self.iteration += int(inputs_steps[0].shape[0])
+        self._score = losses[-1]
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+        return self
+
     def fit(self, data, labels=None, epochs=1):
         """fit(inputs, labels) | fit(MultiDataSet/DataSet) | fit(iterator)."""
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
@@ -244,7 +286,8 @@ class ComputationGraph:
         self.params, self.state, self.opt_state, loss = step(
             self.params, self.state, self.opt_state, inputs, labels,
             jnp.asarray(self.iteration, jnp.int32), masks, label_masks)
-        self._score = float(loss)
+        self._score = loss      # device scalar; host-read deferred to
+                                # get_score() (sync ~100ms on tunneled TPUs)
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
@@ -275,7 +318,8 @@ class ComputationGraph:
         return float(loss)
 
     def get_score(self):
-        return self._score
+        self._score = float(self._score)   # cache: host read is ~100ms on
+        return self._score                 # tunneled TPU attachments
 
     def evaluate(self, data):
         from deeplearning4j_tpu.eval.evaluation import Evaluation

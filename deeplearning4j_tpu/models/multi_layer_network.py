@@ -48,6 +48,7 @@ class MultiLayerNetwork:
         self._rnn_carries = None      # stored state for rnn_time_step
         self._train_step = None
         self._train_step_seq = None
+        self._scan_fit = None
         self._output_fn = None
         self._transforms = None
 
@@ -75,6 +76,7 @@ class MultiLayerNetwork:
                 self._transforms.append(make_gradient_transform(upd))
         self.opt_state = [t.init(p) for t, p in zip(self._transforms, self.params)]
         self._train_step = None  # force re-trace
+        self._scan_fit = None
         self._output_fn = None
 
     def set_listeners(self, *listeners):
@@ -201,6 +203,49 @@ class MultiLayerNetwork:
         return self._train_step[key]
 
     # ------------------------------------------------------------------- fit
+    def fit_scan(self, xs, ys):
+        """Device-resident training: run ``xs.shape[0]`` train steps inside
+        ONE compiled call (lax.scan over a leading step axis), eliminating
+        per-step host dispatch — which dominates small-model training,
+        especially on tunneled TPU attachments (~ms per dispatch).
+
+        ``xs``: (n_steps, batch, ...) features, ``ys``: (n_steps, batch, ...)
+        labels, both device-resident. The reference has no equivalent (its
+        fit loop dispatches per minibatch, MultiLayerNetwork.java:1204); this
+        is the XLA-idiomatic fast path with identical per-step math."""
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError(
+                "fit_scan runs full-sequence backprop; a net configured for "
+                "truncated BPTT must use fit() (the tbptt chunking path)")
+        xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+        if self._scan_fit is None:
+            def inner(params, state, opt_state, xs, ys, it0):
+                def body(carry, inp):
+                    params, state, opt_state, it = carry
+                    x, y = inp
+                    rng = jax.random.fold_in(
+                        jax.random.PRNGKey(self.conf.global_conf.seed), it)
+                    (loss, (new_state, _)), grads = jax.value_and_grad(
+                        self._loss, has_aux=True)(params, state, x, y, rng,
+                                                  None, None, None)
+                    params, opt_state = self._dp_apply_updates(
+                        params, opt_state, grads)
+                    return (params, new_state, opt_state, it + 1), loss
+
+                (p, s, o, _), losses = jax.lax.scan(
+                    body, (params, state, opt_state, it0), (xs, ys))
+                return p, s, o, losses
+
+            self._scan_fit = jax.jit(inner, donate_argnums=(0, 1, 2))
+        self.params, self.state, self.opt_state, losses = self._scan_fit(
+            self.params, self.state, self.opt_state, xs, ys,
+            jnp.asarray(self.iteration, jnp.int32))
+        self.iteration += int(xs.shape[0])
+        self._score = losses[-1]
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+        return self
+
     def fit(self, data, labels=None, epochs=1):
         """fit(x, y) | fit(DataSet) | fit(iterator, epochs=N)
         (parity: MultiLayerNetwork.fit :1156)."""
@@ -236,7 +281,9 @@ class MultiLayerNetwork:
             self.params, self.state, self.opt_state, loss, _ = step(
                 self.params, self.state, self.opt_state, x, y,
                 jnp.asarray(self.iteration, jnp.int32), mf, ml, None)
-            self._score = float(loss)
+            self._score = loss      # device scalar; host-read deferred to
+                                    # get_score() (a sync costs ~100ms on
+                                    # tunneled TPU attachments)
         self._last_fit_time = time.perf_counter() - t0
         self.iteration += 1
         for lst in self.listeners:
@@ -283,7 +330,7 @@ class MultiLayerNetwork:
                         i * 100003 + ep * 1009 + j)
                     self.params[i], loss = jit_step(self.params[i], x, rng,
                                                     jnp.asarray(lr))
-                    self._score = float(loss)
+                    self._score = loss
         return self
 
     def _fit_tbptt(self, x, y, mf, ml):
@@ -304,8 +351,8 @@ class MultiLayerNetwork:
                 self.params, self.state, self.opt_state, xs, ys,
                 jnp.asarray(self.iteration, jnp.int32), mfs, mls, carries)
             carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
-            losses.append(float(loss))
-        self._score = float(np.mean(losses))
+            losses.append(loss)
+        self._score = jnp.mean(jnp.stack(losses))   # device-side mean
 
     # ------------------------------------------------------------- inference
     def output(self, x, train=False, mask=None):
@@ -345,7 +392,8 @@ class MultiLayerNetwork:
         return float(loss)
 
     def get_score(self):
-        return self._score
+        self._score = float(self._score)   # cache: host read is ~100ms on
+        return self._score                 # tunneled TPU attachments
 
     # ------------------------------------------------------------------ rnn
     def rnn_time_step(self, x):

@@ -99,10 +99,9 @@ def _fwd_kernel(gate_in_ref, rw_ref, h0_ref, c0_ref,
     c_new = f * c_s[:] + i * g
     h_new = o * jnp.tanh(c_new)
 
-    gates_ref[0, :, 0 * H:1 * H] = i
-    gates_ref[0, :, 1 * H:2 * H] = f
-    gates_ref[0, :, 2 * H:3 * H] = o
-    gates_ref[0, :, 3 * H:4 * H] = g
+    # one full-width store: per-gate slice stores are lane-aligned only when
+    # H % 128 == 0, and Mosaic rejects partial-lane writes for other H
+    gates_ref[0] = jnp.concatenate([i, f, o, g], axis=-1)
     hs_ref[0] = h_new
     cs_ref[0] = c_new
     h_s[:] = h_new

@@ -1,0 +1,240 @@
+"""On-hardware kernel validation — the ValidateCudnnLSTM pattern, on TPU.
+
+The reference validates its accelerated kernels against the built-in path on
+real hardware (deeplearning4j-cuda/src/test ValidateCudnnLSTM.java,
+TestConvolution.java compare cuDNN vs pure-ND4J outputs/gradients). The CI
+suite here runs the Pallas kernels only in interpreter mode on CPU, so this
+module is the compiled-mode counterpart: it sweeps the ``supported()`` shape
+envelope on the *current backend* (run it on the TPU chip), asserts
+fused-vs-reference equivalence of outputs AND gradients, and times both
+paths.
+
+Run:  python -m deeplearning4j_tpu.ops.validate            # full sweep
+      python -m deeplearning4j_tpu.ops.validate --quick    # small sweep
+Emits one JSON line per case plus a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops import lstm_pallas
+from deeplearning4j_tpu.ops.flash_attention import (flash_attention,
+                                                    supported as fa_supported)
+
+
+# ---------------------------------------------------------------- references
+
+def _lstm_scan_reference(gate_in, rw, h0, c0):
+    """Pure lax.scan LSTM over precomputed gate inputs (the layer's built-in
+    path, restated on the fused kernel's (gate_in, rw, h0, c0) contract)."""
+    H = h0.shape[-1]
+
+    def step(carry, z_t):
+        h, c = carry
+        z = z_t + h @ rw
+        i = jax.nn.sigmoid(z[:, 0 * H:1 * H])
+        f = jax.nn.sigmoid(z[:, 1 * H:2 * H])
+        o = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+        g = jnp.tanh(z[:, 3 * H:4 * H])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    _, (hs, cs) = lax.scan(step, (h0, c0), gate_in)
+    return hs, cs
+
+
+def _attn_reference(q, k, v, causal):
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("btd,bsd->bts", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool)), s, -jnp.inf)
+    return jnp.einsum("bts,bsd->btd", jax.nn.softmax(s, -1), v)
+
+
+# ------------------------------------------------------------------- timing
+
+def _time(fn, *args):
+    """Per-execution op time; see util/timing.py for why naive timing is
+    wrong under the axon tunnel (async dispatch + ~100ms host-read RPC)."""
+    from deeplearning4j_tpu.util.timing import time_op
+    return time_op(fn, *args)
+
+
+def _max_err(a, b):
+    return float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b))))
+
+
+# ---------------------------------------------------------------- LSTM sweep
+
+def validate_lstm_case(b, t, h, rtol=2e-3, atol=2e-4, time_it=True):
+    """Compare fused vs scan outputs and all gradients for one (B, T, H).
+
+    Tolerances are backend-honest: on TPU both paths round MXU matmuls at
+    bf16-multiply/f32-accumulate default precision with different blocking
+    orders, so they agree to ~1e-3 relative, not 1e-5 (the exactness contract
+    is pinned by the CPU interpreter tests in tests/test_ops_kernels.py; this
+    sweep exists to catch Mosaic layout/compile bugs, which are O(1) errors)."""
+    assert lstm_pallas.supported(b, t, h), (b, t, h)
+    rs = np.random.RandomState(h + b + t)
+    gate_in = jnp.asarray(rs.randn(t, b, 4 * h) * 0.4, jnp.float32)
+    rw = jnp.asarray(rs.randn(h, 4 * h) / np.sqrt(h), jnp.float32)
+    h0 = jnp.asarray(rs.randn(b, h) * 0.1, jnp.float32)
+    c0 = jnp.asarray(rs.randn(b, h) * 0.1, jnp.float32)
+    cot_h = jnp.asarray(rs.randn(t, b, h), jnp.float32)
+    cot_c = jnp.asarray(rs.randn(t, b, h), jnp.float32)
+
+    def loss_fused(gi, rw, h0, c0):
+        hs, cs = lstm_pallas.fused_lstm_sequence(gi, rw, h0, c0)
+        return jnp.sum(hs * cot_h) + jnp.sum(cs * cot_c)
+
+    def loss_ref(gi, rw, h0, c0):
+        hs, cs = _lstm_scan_reference(gi, rw, h0, c0)
+        return jnp.sum(hs * cot_h) + jnp.sum(cs * cot_c)
+
+    fwd_fused = jax.jit(lambda *a: lstm_pallas.fused_lstm_sequence(*a))
+    fwd_ref = jax.jit(_lstm_scan_reference)
+    g_fused = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2, 3)))
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))
+
+    hs_f, cs_f = fwd_fused(gate_in, rw, h0, c0)
+    hs_r, cs_r = fwd_ref(gate_in, rw, h0, c0)
+    errs = {"hs": _max_err(hs_f, hs_r), "cs": _max_err(cs_f, cs_r)}
+
+    gf = g_fused(gate_in, rw, h0, c0)
+    gr = g_ref(gate_in, rw, h0, c0)
+    for name, a, b_ in zip(("dgate_in", "drw", "dh0", "dc0"), gf, gr):
+        errs[name] = _max_err(a, b_)
+        scale = float(jnp.max(jnp.abs(b_))) + 1.0
+        assert errs[name] <= atol + rtol * scale, \
+            f"LSTM B={b} T={t} H={h}: {name} err {errs[name]} (scale {scale})"
+    assert errs["hs"] <= atol + rtol and errs["cs"] <= atol + rtol * 3, errs
+
+    res = {"kernel": "fused_lstm", "B": b, "T": t, "H": h,
+           "max_err": round(max(errs.values()), 8)}
+    if time_it:
+        tf = _time(fwd_fused, gate_in, rw, h0, c0)
+        tr = _time(fwd_ref, gate_in, rw, h0, c0)
+        tgf = _time(g_fused, gate_in, rw, h0, c0)
+        tgr = _time(g_ref, gate_in, rw, h0, c0)
+        res.update(fwd_us=round(tf * 1e6, 1), fwd_scan_us=round(tr * 1e6, 1),
+                   fwd_speedup=round(tr / tf, 2),
+                   grad_us=round(tgf * 1e6, 1), grad_scan_us=round(tgr * 1e6, 1),
+                   grad_speedup=round(tgr / tgf, 2))
+    return res
+
+
+# ----------------------------------------------------------- attention sweep
+
+def validate_attention_case(bh, t, dh, causal, rtol=1e-2, atol=1e-3,
+                            time_it=True):
+    """rtol reflects default-precision MXU rounding under different blocking
+    (see validate_lstm_case docstring); exactness is pinned by the CPU
+    interpreter tests."""
+    assert fa_supported(t, dh), (t, dh)
+    rs = np.random.RandomState(t + dh)
+    q, k, v = (jnp.asarray(rs.randn(bh, t, dh), jnp.float32) for _ in range(3))
+    cot = jnp.asarray(rs.randn(bh, t, dh), jnp.float32)
+
+    fa_fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal))
+    ref_fwd = jax.jit(lambda q, k, v: _attn_reference(q, k, v, causal))
+    fa_g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal) * cot),
+        argnums=(0, 1, 2)))
+    ref_g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(_attn_reference(q, k, v, causal) * cot),
+        argnums=(0, 1, 2)))
+
+    o_f, o_r = fa_fwd(q, k, v), ref_fwd(q, k, v)
+    errs = {"o": _max_err(o_f, o_r)}
+    for name, a, b_ in zip("qkv", fa_g(q, k, v), ref_g(q, k, v)):
+        errs["d" + name] = _max_err(a, b_)
+        scale = float(jnp.max(jnp.abs(b_))) + 1.0
+        assert errs["d" + name] <= atol + rtol * scale, \
+            f"FA BH={bh} T={t} Dh={dh} causal={causal}: d{name} " \
+            f"err {errs['d' + name]}"
+    assert errs["o"] <= atol + rtol
+
+    res = {"kernel": "flash_attention", "BH": bh, "T": t, "Dh": dh,
+           "causal": causal, "max_err": round(max(errs.values()), 8)}
+    if time_it:
+        tf = _time(fa_fwd, q, k, v)
+        tr = _time(ref_fwd, q, k, v)
+        tgf = _time(fa_g, q, k, v)
+        tgr = _time(ref_g, q, k, v)
+        res.update(fwd_us=round(tf * 1e6, 1), fwd_ref_us=round(tr * 1e6, 1),
+                   fwd_speedup=round(tr / tf, 2),
+                   grad_us=round(tgf * 1e6, 1), grad_ref_us=round(tgr * 1e6, 1),
+                   grad_speedup=round(tgr / tgf, 2))
+    return res
+
+
+LSTM_SWEEP = [
+    # the supported() envelope edges: small/odd-ish H (8-aligned), big H
+    (1, 4, 8), (4, 16, 8), (8, 16, 24), (4, 32, 56), (8, 32, 120),
+    (16, 64, 128), (32, 64, 256), (32, 128, 256), (64, 32, 512),
+]
+LSTM_QUICK = [(4, 16, 8), (8, 32, 120), (32, 64, 256)]
+
+ATTN_SWEEP = [
+    (2, 16, 8), (4, 64, 32), (8, 128, 64), (8, 256, 64), (4, 512, 128),
+    (2, 1024, 64),
+]
+ATTN_QUICK = [(2, 16, 8), (8, 128, 64)]
+
+
+def run(quick=False, time_it=True):
+    results = []
+    failures = []
+    lstm_cases = LSTM_QUICK if quick else LSTM_SWEEP
+    attn_cases = ATTN_QUICK if quick else ATTN_SWEEP
+    for b, t, h in lstm_cases:
+        try:
+            r = validate_lstm_case(b, t, h, time_it=time_it)
+            results.append(r)
+            print(json.dumps(r))
+        except Exception as e:  # noqa: BLE001 — report every failing shape
+            failures.append({"kernel": "fused_lstm", "B": b, "T": t, "H": h,
+                             "error": f"{type(e).__name__}: {e}"[:300]})
+            print(json.dumps(failures[-1]))
+    for bh, t, dh in attn_cases:
+        for causal in (False, True):
+            try:
+                r = validate_attention_case(bh, t, dh, causal, time_it=time_it)
+                results.append(r)
+                print(json.dumps(r))
+            except Exception as e:  # noqa: BLE001
+                failures.append({"kernel": "flash_attention", "BH": bh,
+                                 "T": t, "Dh": dh, "causal": causal,
+                                 "error": f"{type(e).__name__}: {e}"[:300]})
+                print(json.dumps(failures[-1]))
+    summary = {"backend": jax.default_backend(),
+               "device": jax.devices()[0].device_kind,
+               "passed": len(results), "failed": len(failures)}
+    print(json.dumps(summary))
+    return results, failures
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--no-time", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="write results+failures JSON to this path")
+    a = ap.parse_args()
+    results, failures = run(quick=a.quick, time_it=not a.no_time)
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump({"results": results, "failures": failures,
+                       "backend": jax.default_backend(),
+                       "device": jax.devices()[0].device_kind}, f, indent=1)
+    raise SystemExit(1 if failures else 0)

@@ -179,7 +179,7 @@ class ParallelWrapper:
                         self._step_fn(model.params, model.state, model.opt_state,
                                       x, y, jnp.asarray(model.iteration, jnp.int32),
                                       pad_mask, mf, ml)
-                    model._score = float(loss)
+                    model._score = loss
                     model.iteration += 1
                     for lst in model.listeners:
                         lst.iteration_done(model, model.iteration, model.epoch)
@@ -258,7 +258,7 @@ class ParallelWrapper:
         model.params, model.state, model.opt_state, loss = self._step_fn(
             model.params, model.state, model.opt_state, xs, ys, pms,
             jnp.asarray(model.iteration, jnp.int32))
-        model._score = float(loss)
+        model._score = loss
         model.iteration += len(micro)
         for lst in model.listeners:
             lst.iteration_done(model, model.iteration, model.epoch)
