@@ -1,6 +1,10 @@
 from deeplearning4j_tpu.eval.evaluation import (
     Evaluation, RegressionEvaluation, EvaluationBinary, ROC, ROCMultiClass,
 )
+from deeplearning4j_tpu.eval.calibration import (
+    EvaluationCalibration, ReliabilityDiagram, Histogram,
+)
 
 __all__ = ["Evaluation", "RegressionEvaluation", "EvaluationBinary", "ROC",
-           "ROCMultiClass"]
+           "ROCMultiClass", "EvaluationCalibration", "ReliabilityDiagram",
+           "Histogram"]

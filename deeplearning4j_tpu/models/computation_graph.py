@@ -40,6 +40,8 @@ class ComputationGraph:
         self.iteration = 0
         self.epoch = 0
         self._score = float("nan")
+        self._last_input = None       # last fit batch (activation capture)
+        self._rnn_carries = None      # rnnTimeStep stateMap
         self._train_step_cache = {}
         self._scan_fit = None
         self._output_fn = None
@@ -82,11 +84,17 @@ class ComputationGraph:
         return self
 
     # ----------------------------------------------------------- forward core
-    def _forward(self, params, state, inputs: List, *, train, rng, masks=None):
-        """Forward along topo order. Returns (activations dict, new_state)."""
+    def _forward(self, params, state, inputs: List, *, train, rng, masks=None,
+                 carries=None):
+        """Forward along topo order. Returns (activations dict, new_state,
+        new_carries). ``carries``: dict layer-name → recurrent carry (the
+        reference's rnnTimeStep stateMap, ComputationGraph.java:2362); when
+        given, recurrent layers resume from it and the updated map is
+        returned (None entries mean zero initial state)."""
         gc = self.conf.global_conf
         acts: Dict[str, Any] = {}
         new_state = dict(state)
+        new_carries = dict(carries) if carries is not None else None
         for i, n in enumerate(self.conf.network_inputs):
             x = inputs[i]
             if gc.compute_dtype:
@@ -99,23 +107,33 @@ class ComputationGraph:
             ins = [acts[i] for i in node.inputs]
             if node.kind == "vertex":
                 acts[name] = node.vertex.apply(ins)
+                continue
+            lrng = None if rng is None else jax.random.fold_in(rng, idx)
+            mask = None
+            if masks and node.inputs and node.inputs[0] in masks:
+                mask = masks[node.inputs[0]]
+            if (new_carries is not None
+                    and hasattr(node.layer, "apply_with_carry")):
+                y, c = node.layer.apply_with_carry(
+                    params.get(name, {}), ins[0], new_carries.get(name),
+                    mask=mask)
+                new_carries[name] = c
             else:
-                lrng = None if rng is None else jax.random.fold_in(rng, idx)
-                mask = None
-                if masks and node.inputs and node.inputs[0] in masks:
-                    mask = masks[node.inputs[0]]
                 y, st = node.layer.apply(params.get(name, {}), ins[0],
                                          state.get(name), train=train,
                                          rng=lrng, mask=mask)
-                acts[name] = y
                 if st is not None:
                     new_state[name] = st
-        return acts, new_state
+            acts[name] = y
+        return acts, new_state, new_carries
 
     def _loss(self, params, state, inputs, labels, rng, masks=None,
-              label_masks=None):
-        acts, new_state = self._forward(params, state, inputs, train=True,
-                                        rng=rng, masks=masks)
+              label_masks=None, carries=None):
+        """Aux return is ``new_state`` normally; when ``carries`` is given
+        (tBPTT chunked training) it is ``(new_state, new_carries)``."""
+        acts, new_state, new_carries = self._forward(
+            params, state, inputs, train=True, rng=rng, masks=masks,
+            carries=carries)
         total = 0.0
         for oi, out_name in enumerate(self.conf.network_outputs):
             node = self.conf.nodes[out_name]
@@ -132,6 +150,8 @@ class ComputationGraph:
         gc = self.conf.global_conf
         if gc.compute_dtype:
             total = total.astype(jnp.float32)
+        if carries is not None:
+            return total, (new_state, new_carries)
         return total, new_state
 
     def _normalize_grads(self, grads):
@@ -270,6 +290,7 @@ class ComputationGraph:
     def _fit_batch(self, mds):
         inputs = [jnp.asarray(f) for f in mds.features]
         labels = [jnp.asarray(l) for l in mds.labels]
+        self._last_input = inputs     # device ref for activation capture
         masks = None
         if mds.features_masks and any(m is not None for m in mds.features_masks):
             masks = {n: jnp.asarray(m) for n, m in
@@ -279,19 +300,67 @@ class ComputationGraph:
         if mds.labels_masks and any(m is not None for m in mds.labels_masks):
             label_masks = [None if m is None else jnp.asarray(m)
                            for m in mds.labels_masks]
-        key = (masks is not None, label_masks is not None)
-        if key not in self._train_step_cache:
-            self._train_step_cache[key] = self._make_train_step()
-        step = self._train_step_cache[key]
-        self.params, self.state, self.opt_state, loss = step(
-            self.params, self.state, self.opt_state, inputs, labels,
-            jnp.asarray(self.iteration, jnp.int32), masks, label_masks)
-        self._score = loss      # device scalar; host-read deferred to
+        if (getattr(self.conf, "backprop_type", "standard") == "tbptt"
+                and inputs[0].ndim == 3):
+            self._fit_tbptt(inputs, labels, masks, label_masks)
+        else:
+            key = (masks is not None, label_masks is not None)
+            if key not in self._train_step_cache:
+                self._train_step_cache[key] = self._make_train_step()
+            step = self._train_step_cache[key]
+            self.params, self.state, self.opt_state, loss = step(
+                self.params, self.state, self.opt_state, inputs, labels,
+                jnp.asarray(self.iteration, jnp.int32), masks, label_masks)
+            self._score = loss  # device scalar; host-read deferred to
                                 # get_score() (sync ~100ms on tunneled TPUs)
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
         return self
+
+    # ---------------------------------------------------------------- tbptt
+    def _make_tbptt_step(self):
+        def step(params, state, opt_state, inputs, labels, it, masks,
+                 label_masks, carries):
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self.conf.global_conf.seed), it)
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(params, state, inputs, labels, rng,
+                                          masks, label_masks, carries)
+            new_params, new_opt = self._dp_apply_updates(params, opt_state,
+                                                         grads)
+            return new_params, new_state, new_opt, loss, new_carries
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _fit_tbptt(self, inputs, labels, masks, label_masks):
+        """Truncated BPTT over the graph: slice time into tbptt_fwd_length
+        chunks, carrying recurrent state across chunks (parity:
+        ComputationGraph.java:1617-1629 doTruncatedBPTT). Truncation is
+        structural: each chunk's step differentiates only through its own
+        forward — the carried state enters as a plain (non-differentiated)
+        argument, so no stop_gradient is needed."""
+        T = inputs[0].shape[1]
+        L = self.conf.tbptt_fwd_length
+        if "tbptt" not in self._train_step_cache:
+            self._train_step_cache["tbptt"] = self._make_tbptt_step()
+        step = self._train_step_cache["tbptt"]
+        carries = {}
+        losses = []
+        for start in range(0, T, L):
+            sl = slice(start, start + L)
+            ins = [x[:, sl] if x.ndim == 3 else x for x in inputs]
+            lbs = [y[:, sl] if y.ndim == 3 else y for y in labels]
+            mks = None if masks is None else {
+                n: (m[:, sl] if m.ndim >= 2 else m) for n, m in masks.items()}
+            lms = None if label_masks is None else [
+                None if m is None else (m[:, sl] if m.ndim >= 2 else m)
+                for m in label_masks]
+            self.params, self.state, self.opt_state, loss, carries = step(
+                self.params, self.state, self.opt_state, ins, lbs,
+                jnp.asarray(self.iteration, jnp.int32), mks, lms, carries)
+            losses.append(loss)
+        self._score = jnp.mean(jnp.stack(losses))   # device-side mean
 
     # ------------------------------------------------------------- inference
     def output(self, *inputs, train=False):
@@ -299,8 +368,8 @@ class ComputationGraph:
         inputs = [jnp.asarray(x) for x in inputs]
         if self._output_fn is None:
             def fwd(params, state, inputs):
-                acts, _ = self._forward(params, state, inputs, train=False,
-                                        rng=None)
+                acts, _, _ = self._forward(params, state, inputs, train=False,
+                                           rng=None)
                 return [acts[n] for n in self.conf.network_outputs]
             self._output_fn = jax.jit(fwd)
         outs = self._output_fn(self.params, self.state, inputs)
@@ -320,6 +389,26 @@ class ComputationGraph:
     def get_score(self):
         self._score = float(self._score)   # cache: host read is ~100ms on
         return self._score                 # tunneled TPU attachments
+
+    # ------------------------------------------------------------------ rnn
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference: feed one (or a few) timesteps,
+        recurrent layers resume from the stored state map (parity:
+        ComputationGraph.rnnTimeStep :2362). 2-D inputs are treated as a
+        single timestep (B, F) → (B, 1, F)."""
+        inputs = [jnp.asarray(x) for x in inputs]
+        inputs = [x[:, None, :] if x.ndim == 2 else x for x in inputs]
+        if self._rnn_carries is None:
+            self._rnn_carries = {}
+        acts, _, self._rnn_carries = self._forward(
+            self.params, self.state, inputs, train=False, rng=None,
+            carries=self._rnn_carries)
+        outs = [acts[n] for n in self.conf.network_outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        """Parity: ComputationGraph.rnnClearPreviousState."""
+        self._rnn_carries = None
 
     def evaluate(self, data):
         from deeplearning4j_tpu.eval.evaluation import Evaluation

@@ -45,6 +45,7 @@ class MultiLayerNetwork:
         self.iteration = 0
         self.epoch = 0
         self._score = float("nan")
+        self._last_input = None       # last fit batch (activation capture)
         self._rnn_carries = None      # stored state for rnn_time_step
         self._train_step = None
         self._train_step_seq = None
@@ -273,8 +274,9 @@ class MultiLayerNetwork:
         y = jnp.asarray(ds.labels)
         mf = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         ml = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
-        t0 = time.perf_counter()
-        if self.conf.backprop_type == "tbptt" and x.ndim == 3:
+        self._last_input = x          # device ref for activation-capture
+        t0 = time.perf_counter()      # listeners (ConvolutionalIteration-
+        if self.conf.backprop_type == "tbptt" and x.ndim == 3:   # Listener)
             self._fit_tbptt(x, y, mf, ml)
         else:
             step = self._get_train_step(mf is not None or ml is not None, False)
@@ -335,8 +337,10 @@ class MultiLayerNetwork:
 
     def _fit_tbptt(self, x, y, mf, ml):
         """Truncated BPTT: slice time into tbptt_fwd_length chunks, carrying
-        RNN state (no gradient) across chunks (parity:
-        MultiLayerNetwork.doTruncatedBPTT :1219)."""
+        RNN state across chunks (parity: MultiLayerNetwork.doTruncatedBPTT
+        :1219). Truncation is structural: each chunk's step differentiates
+        only through its own forward — the carried state enters as a plain
+        argument, so no stop_gradient is needed."""
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length
         carries = [None] * len(self.layers)
@@ -350,7 +354,6 @@ class MultiLayerNetwork:
             self.params, self.state, self.opt_state, loss, carries = step(
                 self.params, self.state, self.opt_state, xs, ys,
                 jnp.asarray(self.iteration, jnp.int32), mfs, mls, carries)
-            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
             losses.append(loss)
         self._score = jnp.mean(jnp.stack(losses))   # device-side mean
 

@@ -15,7 +15,6 @@ scalar workload into batched vector ops — the TPU-idiomatic formulation.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import List, Optional
 
@@ -31,16 +30,19 @@ from deeplearning4j_tpu.nlp.tokenization import (
 )
 
 
-@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
-def _sg_neg_step(syn0, syn1neg, table, centers, contexts, lr, key, negative):
-    """One skip-gram negative-sampling batch.
-    centers/contexts: (B,) int32. Returns updated (syn0, syn1neg)."""
+def _sg_neg_batch(syn0, syn1neg, table, centers, contexts, lr, key, negative,
+                  weights=None):
+    """One skip-gram negative-sampling batch (traceable core).
+    centers/contexts: (B,) int32; weights: optional (B,) 0/1 pair weights
+    (0 = padding pair contributing nothing). Returns (syn0, syn1neg)."""
     B = centers.shape[0]
     v = syn0[centers]                      # (B, D)
     # positive pair
     u_pos = syn1neg[contexts]              # (B, D)
     s_pos = jax.nn.sigmoid((v * u_pos).sum(-1))
     g_pos = (1.0 - s_pos) * lr             # (B,)
+    if weights is not None:
+        g_pos = g_pos * weights
     dv = g_pos[:, None] * u_pos
     du_pos = g_pos[:, None] * v
     # negatives: (B, K) draws from the unigram table
@@ -49,6 +51,8 @@ def _sg_neg_step(syn0, syn1neg, table, centers, contexts, lr, key, negative):
     u_neg = syn1neg[negs]                  # (B, K, D)
     s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))
     g_neg = -s_neg * lr                    # (B, K)
+    if weights is not None:
+        g_neg = g_neg * weights[:, None]
     dv = dv + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
     du_neg = g_neg[..., None] * v[:, None, :]
     # scatter updates (duplicate indices accumulate)
@@ -56,6 +60,33 @@ def _sg_neg_step(syn0, syn1neg, table, centers, contexts, lr, key, negative):
     syn1neg = syn1neg.at[contexts].add(du_pos)
     syn1neg = syn1neg.at[negs.reshape(-1)].add(
         du_neg.reshape(B * negative, -1))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
+def _sg_neg_step(syn0, syn1neg, table, centers, contexts, lr, key, negative):
+    """One-dispatch-per-batch variant (kept for ParagraphVectors)."""
+    return _sg_neg_batch(syn0, syn1neg, table, centers, contexts, lr, key,
+                         negative)
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
+def _sg_neg_epoch(syn0, syn1neg, table, centers_b, contexts_b, weights_b,
+                  lrs, key, negative):
+    """A whole epoch of skip-gram NEG batches in ONE compiled lax.scan —
+    one dispatch instead of one per batch, which matters enormously on
+    high-latency device attachments (~100ms RPC per transfer here).
+    centers_b/contexts_b/weights_b: (S, B); lrs: (S,) per-batch LR."""
+    def body(carry, inp):
+        syn0, syn1neg, key = carry
+        c, t, w, lr = inp
+        key, sub = jax.random.split(key)
+        syn0, syn1neg = _sg_neg_batch(syn0, syn1neg, table, c, t, lr, sub,
+                                      negative, weights=w)
+        return (syn0, syn1neg, key), jnp.float32(0)
+
+    (syn0, syn1neg, _), _ = jax.lax.scan(
+        body, (syn0, syn1neg, key), (centers_b, contexts_b, weights_b, lrs))
     return syn0, syn1neg
 
 
@@ -196,42 +227,73 @@ class Word2Vec:
         self.syn1 = jnp.zeros((V, D), jnp.float32)
         self._table = jnp.asarray(unigram_table(self.vocab), jnp.int32)
 
+    def _keep_probs(self) -> np.ndarray:
+        """Per-vocab-index subsampling keep probability (Mikolov formula,
+        parity: the reference's per-word ``ran`` threshold)."""
+        vocab = self.vocab
+        total = max(vocab.total_word_count, 1)
+        counts = np.array([vocab._by_index[i].count
+                           for i in range(vocab.num_words())], np.float64)
+        if not self.subsampling or self.subsampling <= 0:
+            return np.ones(len(counts))
+        f = counts / total
+        with np.errstate(divide="ignore", invalid="ignore"):
+            p = (np.sqrt(f / self.subsampling) + 1) * self.subsampling / f
+        return np.minimum(np.nan_to_num(p, nan=1.0, posinf=1.0), 1.0)
+
     def _encode_corpus(self):
-        """Corpus → list of index arrays (with subsampling)."""
+        """Corpus → list of index arrays (with subsampling). Vocab lookup is
+        one dict hit per token; subsampling is a vectorized bernoulli over a
+        precomputed per-index keep probability."""
         vocab = self.vocab
         rng = np.random.RandomState(self.seed + 17)
-        total = max(vocab.total_word_count, 1)
+        p_keep = self._keep_probs()
         seqs = []
         for toks in self._sequences():
-            idx = [vocab.index_of(t) for t in toks]
-            idx = [i for i in idx if i >= 0]
-            if self.subsampling and self.subsampling > 0:
-                kept = []
-                for i in idx:
-                    f = vocab._by_index[i].count / total
-                    p = (math.sqrt(f / self.subsampling) + 1) * self.subsampling / f
-                    if p >= 1.0 or rng.rand() < p:
-                        kept.append(i)
-                idx = kept
-            if len(idx) > 1:
-                seqs.append(np.asarray(idx, np.int32))
+            idx = np.fromiter((vocab.index_of(t) for t in toks),
+                              np.int64, count=len(toks))
+            idx = idx[idx >= 0]
+            if idx.size:
+                idx = idx[rng.rand(idx.size) < p_keep[idx]]
+            if idx.size > 1:
+                seqs.append(idx.astype(np.int32))
         return seqs
+
+    @staticmethod
+    def _flatten(seqs):
+        """List of index arrays → (flat tokens, sentence ids)."""
+        flat = np.concatenate(seqs) if seqs else np.zeros(0, np.int32)
+        sids = np.repeat(np.arange(len(seqs), dtype=np.int32),
+                         [len(s) for s in seqs]) if seqs else \
+            np.zeros(0, np.int32)
+        return flat, sids
 
     def _make_pairs(self, seqs, rng):
         """(center, context) pairs with the reference's randomized effective
-        window (b = random in [1, window])."""
-        centers, contexts = [], []
-        for seq in seqs:
-            n = len(seq)
-            wins = rng.randint(1, self.window_size + 1, size=n)
-            for i in range(n):
-                w = wins[i]
-                lo, hi = max(0, i - w), min(n, i + w + 1)
-                for j in range(lo, hi):
-                    if j != i:
-                        centers.append(seq[i])
-                        contexts.append(seq[j])
-        return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+        window (b = random in [1, window] per CENTER), vectorized: one numpy
+        pass per window offset over the flattened corpus instead of a Python
+        loop per token (the reference parallelizes the same loop across
+        VectorCalculationsThreads; here the loop disappears entirely)."""
+        flat, sids = self._flatten(seqs)
+        n = len(flat)
+        if n == 0:
+            return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+        wins = rng.randint(1, self.window_size + 1, size=n)
+        cs, ts = [], []
+        for d in range(1, self.window_size + 1):
+            if d >= n:
+                break
+            same = sids[:-d] == sids[d:]
+            # center i, context i+d (right neighbor within i's window)
+            i = np.nonzero(same & (wins[:-d] >= d))[0]
+            cs.append(flat[i])
+            ts.append(flat[i + d])
+            # center i+d, context i (left neighbor within (i+d)'s window)
+            j = np.nonzero(same & (wins[d:] >= d))[0] + d
+            cs.append(flat[j])
+            ts.append(flat[j - d])
+        return (np.concatenate(cs).astype(np.int32),
+                np.concatenate(ts).astype(np.int32))
 
     def _effective_batch(self):
         """Batched scatter-adds accumulate duplicate-pair updates linearly,
@@ -243,6 +305,11 @@ class Word2Vec:
 
     # ------------------------------------------------------------------- fit
     def fit(self):
+        if self.algorithm == "cbow" and self.use_hs:
+            raise NotImplementedError(
+                "CBOW + hierarchical softmax is not implemented; use "
+                "negative sampling (use_hierarchic_softmax=False) or "
+                "the skip-gram algorithm with HS")
         if self.vocab is None:
             self.build_vocab()
         if self.syn0 is None:
@@ -265,6 +332,13 @@ class Word2Vec:
                 msk[w.index, :l] = 1.0
             pts_j, cds_j, msk_j = map(jnp.asarray, (pts, cds, msk))
 
+        if self.algorithm == "cbow":
+            # CBOW trains on (window, target) batches only — running the
+            # skip-gram pair loop as well would double-train syn0
+            self._fit_cbow(seqs, rng, key)
+            self._norm_cache = None
+            return self
+
         centers_all, contexts_all = self._make_pairs(seqs, rng)
         bs = self._effective_batch()
         n_pairs = len(centers_all)
@@ -272,6 +346,27 @@ class Word2Vec:
         step_i = 0
         for ep in range(self.epochs):
             order = rng.permutation(n_pairs)
+            if not self.use_hs:
+                # whole epoch in one compiled scan: shuffle + pad the last
+                # batch with zero-weight pairs, ship (S, B) batches once
+                S = (n_pairs + bs - 1) // bs
+                pad = S * bs - n_pairs
+                sel = np.concatenate([order, np.zeros(pad, order.dtype)])
+                w = np.concatenate([np.ones(n_pairs, np.float32),
+                                    np.zeros(pad, np.float32)])
+                lrs = np.maximum(
+                    self.min_learning_rate,
+                    self.learning_rate
+                    * (1.0 - (step_i + np.arange(S)) / total_steps))
+                key, sub = jax.random.split(key)
+                self.syn0, self.syn1 = _sg_neg_epoch(
+                    self.syn0, self.syn1, self._table,
+                    jnp.asarray(centers_all[sel].reshape(S, bs)),
+                    jnp.asarray(contexts_all[sel].reshape(S, bs)),
+                    jnp.asarray(w.reshape(S, bs)),
+                    jnp.asarray(lrs, jnp.float32), sub, self.negative)
+                step_i += S
+                continue
             for s in range(0, n_pairs, bs):
                 sel = order[s:s + bs]
                 lr = max(self.min_learning_rate,
@@ -279,47 +374,42 @@ class Word2Vec:
                 c = jnp.asarray(centers_all[sel])
                 t = jnp.asarray(contexts_all[sel])
                 key, sub = jax.random.split(key)
-                if self.algorithm == "cbow":
-                    # build window matrices for cbow on the fly
-                    pass
-                if self.use_hs:
-                    self.syn0, self.syn1 = _sg_hs_step(
-                        self.syn0, self.syn1, c, pts_j[t], cds_j[t], msk_j[t],
-                        jnp.float32(lr))
-                else:
-                    self.syn0, self.syn1 = _sg_neg_step(
-                        self.syn0, self.syn1, self._table, c, t,
-                        jnp.float32(lr), sub, self.negative)
+                self.syn0, self.syn1 = _sg_hs_step(
+                    self.syn0, self.syn1, c, pts_j[t], cds_j[t], msk_j[t],
+                    jnp.float32(lr))
                 step_i += 1
 
-        if self.algorithm == "cbow":
-            self._fit_cbow(seqs, rng, key)
         self._norm_cache = None
         return self
 
+    def _make_cbow_windows(self, seqs, rng):
+        """Vectorized (contexts, mask, targets) window matrices: one numpy
+        pass per offset, mirroring _make_pairs."""
+        W = self.window_size
+        flat, sids = self._flatten(seqs)
+        n = len(flat)
+        ctxs = np.zeros((n, 2 * W), np.int32)
+        masks = np.zeros((n, 2 * W), np.float32)
+        if n:
+            wins = rng.randint(1, W + 1, size=n)
+            for d in range(1, W + 1):
+                if d >= n:
+                    break
+                same = sids[:-d] == sids[d:]
+                # left neighbor i-d of center i → column d-1
+                li = np.nonzero(same & (wins[d:] >= d))[0] + d
+                ctxs[li, d - 1] = flat[li - d]
+                masks[li, d - 1] = 1.0
+                # right neighbor i+d of center i → column W+d-1
+                ri = np.nonzero(same & (wins[:-d] >= d))[0]
+                ctxs[ri, W + d - 1] = flat[ri + d]
+                masks[ri, W + d - 1] = 1.0
+        keep = masks.sum(axis=1) > 0
+        return ctxs[keep], masks[keep], flat[keep].astype(np.int32)
+
     def _fit_cbow(self, seqs, rng, key):
         """CBOW pass: batches of (context window, target)."""
-        W = 2 * self.window_size
-        ctxs, masks, targets = [], [], []
-        for seq in seqs:
-            n = len(seq)
-            wins = rng.randint(1, self.window_size + 1, size=n)
-            for i in range(n):
-                w = wins[i]
-                lo, hi = max(0, i - w), min(n, i + w + 1)
-                window = [seq[j] for j in range(lo, hi) if j != i]
-                if not window:
-                    continue
-                row = np.zeros(W, np.int32)
-                m = np.zeros(W, np.float32)
-                row[:len(window)] = window[:W]
-                m[:len(window)] = 1.0
-                ctxs.append(row)
-                masks.append(m)
-                targets.append(seq[i])
-        ctxs = np.asarray(ctxs)
-        masks = np.asarray(masks)
-        targets = np.asarray(targets, np.int32)
+        ctxs, masks, targets = self._make_cbow_windows(seqs, rng)
         n = len(targets)
         bs = self._effective_batch()
         total = max(1, self.epochs * ((n + bs - 1) // bs))

@@ -30,9 +30,11 @@ def initialize(coordinator_address: Optional[str] = None,
                process_id: Optional[int] = None):
     """Initialize the multi-host JAX runtime (idempotent, env-var driven like
     jax itself: COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID if args omitted).
-    Call once per host process before building meshes."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    Call once per host process before building meshes — and before ANYTHING
+    that touches the XLA backend (jax.devices/process_count included), which
+    is why the already-initialized check must not query the backend."""
+    if jax.distributed.is_initialized():
+        return
     kwargs = {}
     if coordinator_address or os.environ.get("COORDINATOR_ADDRESS"):
         kwargs["coordinator_address"] = (coordinator_address or

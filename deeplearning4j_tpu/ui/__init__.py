@@ -19,9 +19,11 @@ from deeplearning4j_tpu.ui.storage import (
     RemoteUIStatsStorageRouter, StatsReport,
 )
 from deeplearning4j_tpu.ui.stats_listener import StatsListener
+from deeplearning4j_tpu.ui.conv_listener import ConvolutionalIterationListener
 from deeplearning4j_tpu.ui.server import UIServer
 
 __all__ = [
     "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
-    "RemoteUIStatsStorageRouter", "StatsReport", "StatsListener", "UIServer",
+    "RemoteUIStatsStorageRouter", "StatsReport", "StatsListener",
+    "ConvolutionalIterationListener", "UIServer",
 ]

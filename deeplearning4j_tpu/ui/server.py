@@ -1,12 +1,15 @@
-"""Web UI server: train overview + model info + remote stats receiver.
+"""Web UI server: train overview / model / system pages + activations +
+remote stats receiver.
 
 Parity: deeplearning4j-play PlayUIServer.java (singleton ``UIServer
-.get_instance().attach(storage)``), module/train/TrainModule.java (overview
-and model endpoints), module/remote/RemoteReceiverModule.java (POST /remote).
+.get_instance().attach(storage)``), module/train/TrainModule.java (overview,
+per-layer model page with update:param ratio charts, system/memory page),
+ui/weights/ConvolutionalIterationListener.java rendering (activations page),
+module/remote/RemoteReceiverModule.java (POST /remote).
 
-Design: stdlib ThreadingHTTPServer — no Play/netty equivalent needed; the
-overview page is a single self-contained HTML document (inline canvas
-charts, fetch polling — no external assets, works in air-gapped pods)."""
+Design: stdlib ThreadingHTTPServer — no Play/netty equivalent needed; each
+page is a single self-contained HTML document (inline canvas charts, fetch
+polling — no external assets, works in air-gapped pods)."""
 
 from __future__ import annotations
 
@@ -78,6 +81,118 @@ async function refresh(){
 setInterval(refresh, 2000); refresh();
 </script></body></html>"""
 
+_NAV = ('<p><a href="/train">overview</a> | <a href="/train/model">model</a>'
+        ' | <a href="/train/system">system</a>'
+        ' | <a href="/train/activations">activations</a></p>')
+
+_CHART_JS = """
+function line(id, xs, ys, color){
+  const c=document.getElementById(id);
+  c.width=c.clientWidth; c.height=c.clientHeight;
+  const g=c.getContext('2d');
+  g.clearRect(0,0,c.width,c.height);
+  if(ys.length<2) return;
+  const fy=ys.filter(Number.isFinite);
+  if(!fy.length) return;
+  const ymin=Math.min(...fy), ymax=Math.max(...fy);
+  const sx=(c.width-50)/(xs.length-1), sy=(c.height-30)/((ymax-ymin)||1);
+  g.strokeStyle=color||'#2a6cc4'; g.lineWidth=1.5; g.beginPath();
+  ys.forEach((y,i)=>{const px=40+i*sx, py=c.height-20-(y-ymin)*sy;
+    i?g.lineTo(px,py):g.moveTo(px,py);});
+  g.stroke();
+  g.fillStyle='#333'; g.font='11px sans-serif';
+  g.fillText(ymax.toPrecision(4),2,12);
+  g.fillText(ymin.toPrecision(4),2,c.height-22);
+}
+async function pickSession(){
+  const sel=document.getElementById('sess');
+  const sids=await (await fetch('/train/sessions')).json();
+  if(sel.options.length!=sids.length)
+    sel.innerHTML=sids.map(s=>`<option>${s}</option>`).join('');
+  return sel.value;
+}
+"""
+
+_STYLE = """<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} h2{font-size:16px}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;
+      padding:12px;margin:10px 0}
+canvas{width:100%;height:180px}
+table{border-collapse:collapse;font-size:13px}
+td,th{border:1px solid #ccc;padding:3px 8px;text-align:right}
+th{background:#eee} select{font-size:14px;padding:2px}
+img{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
+</style>"""
+
+_MODEL_PAGE = f"""<!DOCTYPE html>
+<html><head><title>DL4J-TPU Model</title>{_STYLE}</head><body>
+<h1>model &mdash; per-layer parameters</h1>{_NAV}
+<div class="card">Session: <select id="sess"></select></div>
+<div id="layers"></div>
+<script>{_CHART_JS}
+async function refresh(){{
+  const sid=await pickSession(); if(!sid) return;
+  const d=await (await fetch('/train/model/data?sid='+sid)).json();
+  const host=document.getElementById('layers');
+  for(const [g, s] of Object.entries(d.series||{{}})){{
+    const id='c_'+g.replace(/[^a-zA-Z0-9]/g,'_');
+    if(!document.getElementById(id)){{
+      const div=document.createElement('div'); div.className='card';
+      div.innerHTML=`<h2>${{g}} &mdash; log10 update:param ratio</h2>
+        <canvas id="${{id}}"></canvas>
+        <h2 style="margin-top:8px">mean magnitude</h2>
+        <canvas id="${{id}}_mm"></canvas>`;
+      host.appendChild(div);
+    }}
+    line(id, s.iterations, s.logRatio, '#c44');
+    line(id+'_mm', s.iterations, s.paramMeanMag, '#2a6cc4');
+  }}
+}}
+setInterval(refresh, 3000); refresh();
+</script></body></html>"""
+
+_SYSTEM_PAGE = f"""<!DOCTYPE html>
+<html><head><title>DL4J-TPU System</title>{_STYLE}</head><body>
+<h1>system</h1>{_NAV}
+<div class="card">Session: <select id="sess"></select>
+ <span id="info"></span></div>
+<div class="card"><h2>Host memory RSS (MB)</h2><canvas id="mem"></canvas></div>
+<div class="card"><h2>Iteration time (ms)</h2><canvas id="it"></canvas></div>
+<div class="card"><h2>Batches/sec</h2><canvas id="bps"></canvas></div>
+<script>{_CHART_JS}
+async function refresh(){{
+  const sid=await pickSession(); if(!sid) return;
+  const d=await (await fetch('/train/system/data?sid='+sid)).json();
+  line('mem', d.iterations, d.memRssMb, '#393');
+  line('it', d.iterations, d.iterationTimesMs);
+  line('bps', d.iterations, d.batchesPerSec, '#c44');
+  document.getElementById('info').textContent=
+    ` device: ${{d.device||'?'}}, backend: ${{d.backend||'?'}}`;
+}}
+setInterval(refresh, 3000); refresh();
+</script></body></html>"""
+
+_ACTIVATIONS_PAGE = f"""<!DOCTYPE html>
+<html><head><title>DL4J-TPU Activations</title>{_STYLE}</head><body>
+<h1>convolutional activations</h1>{_NAV}
+<div class="card">Session: <select id="sess"></select>
+ <span id="meta"></span></div>
+<div id="grids"></div>
+<script>{_CHART_JS}
+async function refresh(){{
+  const sid=await pickSession(); if(!sid) return;
+  const d=await (await fetch('/train/activations/data?sid='+sid)).json();
+  document.getElementById('meta').textContent=
+    d.iteration!=null?` iteration ${{d.iteration}}`:' (no captures yet)';
+  const host=document.getElementById('grids');
+  host.innerHTML=Object.entries(d.images||{{}}).map(([k,v])=>
+    `<div class="card"><h2>${{k}}</h2>
+     <img src="data:image/png;base64,${{v}}" width="60%"></div>`).join('');
+}}
+setInterval(refresh, 3000); refresh();
+</script></body></html>"""
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTpuUI/1.0"
@@ -97,15 +212,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _html(self, page: str):
+        data = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
         u = urlparse(self.path)
         if u.path in ("/", "/train", "/train/overview.html"):
-            data = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            self._html(_PAGE)
             return
         if u.path == "/train/sessions":
             sids = []
@@ -128,12 +246,76 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if u.path == "/train/model":
             sid = parse_qs(u.query).get("sid", [None])[0]
+            if sid is None:                       # page; ?sid= keeps the
+                self._html(_MODEL_PAGE)           # static-info JSON API
+                return
             for st in self.storages:
                 info = st.get_static_info(sid)
                 if info:
                     self._json(info)
                     return
             self._json({}, 404)
+            return
+        if u.path == "/train/model/data":
+            sid = parse_qs(u.query).get("sid", [None])[0]
+            ups: List[StatsReport] = []
+            for st in self.storages:
+                ups.extend(st.get_all_updates(sid) if sid else [])
+            ups.sort(key=lambda r: r.iteration)
+            series = {}
+            for r in ups:
+                for g, ps in (r.param_stats or {}).items():
+                    us = (r.update_stats or {}).get(g)
+                    s = series.setdefault(g, {"iterations": [],
+                                              "logRatio": [],
+                                              "paramMeanMag": []})
+                    s["iterations"].append(r.iteration)
+                    pmm = ps.get("meanmag", ps.get("norm", 0.0))
+                    s["paramMeanMag"].append(pmm)
+                    if us and pmm > 0:
+                        umm = us.get("meanmag", us.get("norm", 0.0))
+                        import math
+                        s["logRatio"].append(
+                            math.log10(umm / pmm) if umm > 0 else float("nan"))
+                    else:
+                        s["logRatio"].append(float("nan"))
+            self._json({"series": series})
+            return
+        if u.path == "/train/system":
+            self._html(_SYSTEM_PAGE)
+            return
+        if u.path == "/train/system/data":
+            sid = parse_qs(u.query).get("sid", [None])[0]
+            ups = []
+            for st in self.storages:
+                ups.extend(st.get_all_updates(sid) if sid else [])
+            ups.sort(key=lambda r: r.iteration)
+            out = {
+                "iterations": [r.iteration for r in ups],
+                "memRssMb": [r.mem_rss / 1e6 for r in ups],
+                "iterationTimesMs": [r.iteration_time_ms for r in ups],
+                "batchesPerSec": [r.batches_per_sec for r in ups],
+            }
+            try:
+                import jax
+                d = jax.devices()[0]
+                out["device"] = d.device_kind
+                out["backend"] = jax.default_backend()
+            except Exception:
+                pass
+            self._json(out)
+            return
+        if u.path == "/train/activations":
+            self._html(_ACTIVATIONS_PAGE)
+            return
+        if u.path == "/train/activations/data":
+            sid = parse_qs(u.query).get("sid", [None])[0]
+            for st in self.storages:
+                info = st.get_static_info(f"{sid}/activations")
+                if info:
+                    self._json(info)
+                    return
+            self._json({"images": {}})
             return
         self._json({"error": "not found", "path": u.path}, 404)
 

@@ -21,17 +21,37 @@ from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.ui.storage import StatsReport
 
 
-def _summary(tree) -> dict:
+def _stat(a) -> dict:
+    a = np.asarray(a, dtype=np.float32)
+    return {
+        "mean": float(a.mean()), "std": float(a.std()),
+        "min": float(a.min()), "max": float(a.max()),
+        "norm": float(np.sqrt((a.astype(np.float64) ** 2).sum())),
+        "meanmag": float(np.abs(a).mean()),   # the model-page ratio chart
+    }                                         # uses mean magnitudes
+
+
+def _named_groups(model, tree):
+    """Yield (display_name, param_dict) per layer — 'i:Type' for the
+    sequential container, the vertex name for graphs (TrainModule's
+    per-layer charts key on these)."""
+    if isinstance(tree, list):                # MultiLayerNetwork
+        for i, (layer, p) in enumerate(zip(model.layers, tree)):
+            if p:
+                yield f"{i}:{type(layer).__name__}", p
+    elif isinstance(tree, dict):              # ComputationGraph
+        for name, p in tree.items():
+            if p:
+                yield name, p
+
+
+def _summary(model, tree) -> dict:
     out = {}
-    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
-        a = np.asarray(leaf, dtype=np.float32)
-        if a.size == 0:
-            continue
-        out[str(i)] = {
-            "mean": float(a.mean()), "std": float(a.std()),
-            "min": float(a.min()), "max": float(a.max()),
-            "norm": float(np.sqrt((a.astype(np.float64) ** 2).sum())),
-        }
+    for gname, p in _named_groups(model, tree):
+        for k, leaf in p.items():
+            a = np.asarray(leaf)
+            if a.size:
+                out[f"{gname}/{k}"] = _stat(a)
     return out
 
 
@@ -48,11 +68,17 @@ class StatsListener(IterationListener):
         self._static_sent = False
 
     def _send_static(self, model):
+        if hasattr(model, "layers"):                  # MultiLayerNetwork
+            layer_names = [type(l).__name__ for l in model.layers]
+        else:                                         # ComputationGraph
+            layer_names = [f"{n}:{type(model.conf.nodes[n].layer).__name__}"
+                           for n in model.conf.topological_order
+                           if model.conf.nodes[n].kind == "layer"]
         info = {
             "model": type(model).__name__,
             "numParams": int(model.num_params()),
-            "numLayers": len(model.layers),
-            "layers": [type(l).__name__ for l in model.layers],
+            "numLayers": len(layer_names),
+            "layers": layer_names,
         }
         try:
             info["configJson"] = model.conf.to_json()
@@ -81,12 +107,12 @@ class StatsListener(IterationListener):
         r.mem_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
 
         if self.collect_param_stats and model.params is not None:
-            r.param_stats = _summary(model.params)
+            r.param_stats = _summary(model, model.params)
             if self._last_params is not None:
                 delta = jax.tree_util.tree_map(
                     lambda a, b: np.asarray(a) - np.asarray(b),
                     model.params, self._last_params)
-                r.update_stats = _summary(delta)
+                r.update_stats = _summary(model, delta)
             self._last_params = jax.tree_util.tree_map(np.asarray, model.params)
 
         gc = model.conf.global_conf

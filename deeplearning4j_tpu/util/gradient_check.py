@@ -12,26 +12,47 @@ reference forces DOUBLE data type in its gradient-check tests.
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 import jax
-
-# x64 MUST be on before any array in the checked function is created —
-# central differences at eps~1e-6 cancel catastrophically in float32. This is
-# a test-time utility; importing it opts the process into x64 (the reference
-# similarly forces DataBuffer.Type.DOUBLE in its gradient-check suites).
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def _x64():
+    """Scope float64 to the check (central differences at eps~1e-6 cancel
+    catastrophically in float32; the reference similarly forces
+    DataBuffer.Type.DOUBLE in its gradient-check suites). A process-global
+    ``jax.config.update`` would leak x64 defaults into every test imported
+    after this module — the context manager keeps it local."""
+    with jax.enable_x64():
+        yield
 
 
 def gradient_check_fn(loss_fn, params, eps=1e-6, max_rel_error=1e-3,
                       min_abs_error=1e-8, max_checks_per_array=25, seed=0,
                       verbose=False):
-    """Check d loss_fn / d params via central differences.
+    """Check d loss_fn / d params via central differences (in scoped x64).
 
     loss_fn: params_pytree -> scalar. Must be pure.
     Returns (n_failures, n_checked, max_rel_err_seen).
     """
+    with _x64():
+        return _gradient_check_fn_x64(loss_fn, params, eps, max_rel_error,
+                                      min_abs_error, max_checks_per_array,
+                                      seed, verbose)
+
+
+def _gradient_check_fn_x64(loss_fn, params, eps, max_rel_error,
+                           min_abs_error, max_checks_per_array, seed,
+                           verbose):
+    # upcast float params HERE, inside the x64 scope — callers can pass f32
+    # pytrees without caring about the x64 state of their own context
+    params = jax.tree_util.tree_map(
+        lambda a: (jnp.asarray(a, jnp.float64)
+                   if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                   else jnp.asarray(a)), params)
     loss_fn = jax.jit(loss_fn)  # compile once; FD loop then runs fast
     grads = jax.jit(jax.grad(loss_fn))(params)
     leaves, treedef = jax.tree_util.tree_flatten(params)
@@ -77,17 +98,17 @@ def gradient_check_network(net, x, y, eps=1e-5, max_rel_error=1e-3,
                            verbose=False):
     """Gradient-check a MultiLayerNetwork's full loss (incl. l1/l2) wrt all
     params (parity: GradientCheckUtil.checkGradients)."""
-    x = jnp.asarray(x, jnp.float64) if x.dtype != np.int32 else jnp.asarray(x)
-    y = jnp.asarray(y, jnp.float64)
-    params64 = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64),
-                                      net.params)
+    with _x64():
+        x = jnp.asarray(x, jnp.float64) if x.dtype != np.int32 \
+            else jnp.asarray(x)
+        y = jnp.asarray(y, jnp.float64)
+        params64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, jnp.float64), net.params)
 
-    def loss_fn(params):
-        loss, _ = net._loss(params, net.state, x, y, None, None, None)
-        return loss
+        def loss_fn(params):
+            loss, _ = net._loss(params, net.state, x, y, None, None, None)
+            return loss
 
-    return gradient_check_fn(loss_fn, params64, eps=eps,
-                             max_rel_error=max_rel_error,
-                             min_abs_error=min_abs_error,
-                             max_checks_per_array=max_checks_per_array,
-                             verbose=verbose)
+        return _gradient_check_fn_x64(loss_fn, params64, eps, max_rel_error,
+                                      min_abs_error, max_checks_per_array,
+                                      0, verbose)

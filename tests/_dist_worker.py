@@ -1,0 +1,68 @@
+"""Worker process for the two-process multi-host test (not a pytest module).
+
+Each process owns 2 virtual CPU devices; the 2-process cluster forms a
+4-device global mesh. Validates deeplearning4j_tpu.parallel.distributed
+initialize()/pod_mesh()/local_batch_slice() and that a psum actually sums
+across process boundaries — the reference's Spark `local[N]`-style
+distributed test, but over real process boundaries (SURVEY.md §4).
+
+Usage: _dist_worker.py <coordinator_port> <process_id> <num_processes>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+
+def main():
+    port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.parallel import distributed
+
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.process_index() == pid
+
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == 2 * nproc, n_global
+    assert n_local == 2, n_local
+
+    mesh = distributed.pod_mesh(("data",))
+    assert mesh.devices.size == n_global
+
+    # psum across the full pod: each device contributes (global_index + 1);
+    # every process must see the same whole-cluster total.
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from functools import partial
+
+    vals = np.arange(1, n_global + 1, dtype=np.float32)
+    sharding = NamedSharding(mesh, P("data"))
+    garr = jax.make_array_from_callback(
+        (n_global,), sharding, lambda idx: vals[idx])
+
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P())
+    def total(x):
+        return jax.lax.psum(x.sum(), "data")[None]
+
+    got = float(jax.jit(total)(garr).addressable_shards[0].data[0])
+    want = float(vals.sum())
+    assert got == want, (got, want)
+
+    sl = distributed.local_batch_slice(8)
+    assert sl == slice(pid * 4, (pid + 1) * 4), sl
+
+    print(f"WORKER_{pid}_OK psum={got}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""ComputationGraph recurrent parity: rnn_time_step + truncated BPTT.
+
+Reference: ComputationGraph.java:2362 (rnnTimeStep with stateMap) and
+:1617-1629 (doTruncatedBPTT). Oracles: full-sequence output() for streaming
+equivalence, the standard train step for single-chunk tBPTT, and the
+MultiLayerNetwork tBPTT path (already gradient-checked) for the chunked case.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.models import ComputationGraph
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import LSTM
+from deeplearning4j_tpu.nn.layers.rnn import RnnOutputLayer
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+
+
+F, H, C = 5, 8, 4
+
+
+def _cg(backprop_type="standard", tbptt=100):
+    b = (NeuralNetConfiguration.builder()
+         .seed(11)
+         .updater(Sgd(0.1))
+         .weight_init("xavier")
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(F))
+         .add_layer("lstm", LSTM(n_out=H, activation="tanh"), "in")
+         .add_layer("out", RnnOutputLayer(n_out=C, activation="softmax",
+                                          loss="mcxent"), "lstm"))
+    if backprop_type == "tbptt":
+        b.backprop_type("tbptt", tbptt, tbptt)
+    return ComputationGraph(b.set_outputs("out").build()).init()
+
+
+def _mln(backprop_type="standard", tbptt=100):
+    b = (NeuralNetConfiguration.builder()
+         .seed(11)
+         .updater(Sgd(0.1))
+         .weight_init("xavier")
+         .list()
+         .layer(LSTM(n_out=H, activation="tanh"))
+         .layer(RnnOutputLayer(n_out=C, activation="softmax", loss="mcxent")))
+    if backprop_type == "tbptt":
+        b.backprop_type("tbptt", tbptt, tbptt)
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(F)).build()).init()
+
+
+def _seq(b=3, t=12, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(b, t, F).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rs.randint(0, C, (b, t))]
+    return x, y
+
+
+class TestCGRnnTimeStep:
+    def test_streaming_matches_full_sequence(self):
+        cg = _cg()
+        x, _ = _seq()
+        full = np.asarray(cg.output(x))
+        cg.rnn_clear_previous_state()
+        outs = [np.asarray(cg.rnn_time_step(x[:, t])) for t in range(x.shape[1])]
+        stream = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(stream, full, rtol=1e-5, atol=1e-6)
+
+    def test_state_persists_and_clears(self):
+        cg = _cg()
+        x, _ = _seq(t=2)
+        first = np.asarray(cg.rnn_time_step(x[:, 0]))
+        second = np.asarray(cg.rnn_time_step(x[:, 0]))   # same input, new state
+        assert not np.allclose(first, second)
+        cg.rnn_clear_previous_state()
+        again = np.asarray(cg.rnn_time_step(x[:, 0]))
+        np.testing.assert_allclose(again, first, rtol=1e-6)
+
+    def test_matches_mln_stream(self):
+        cg, mln = _cg(), _mln()
+        x, _ = _seq(seed=4)
+        a = np.asarray(cg.rnn_time_step(x[:, :6]))
+        b = np.asarray(mln.rnn_time_step(x[:, :6]))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+        a2 = np.asarray(cg.rnn_time_step(x[:, 6:]))
+        b2 = np.asarray(mln.rnn_time_step(x[:, 6:]))
+        np.testing.assert_allclose(a2, b2, rtol=1e-5, atol=1e-6)
+
+
+class TestCGTbptt:
+    def test_single_chunk_equals_standard_step(self):
+        """tbptt with L >= T must reproduce the standard full-BPTT update."""
+        x, y = _seq()
+        std = _cg("standard")
+        std.fit(x, y)
+        tb = _cg("tbptt", tbptt=100)
+        tb.fit(x, y)
+        for name in std.params:
+            for k in std.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(tb.params[name][k]),
+                    np.asarray(std.params[name][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"{name}/{k}")
+
+    def test_chunked_matches_mln_tbptt(self):
+        """CG tBPTT must produce the same chunked updates as the (gradient-
+        checked) MLN tBPTT on an identical stack."""
+        x, y = _seq(b=2, t=12, seed=9)
+        cg = _cg("tbptt", tbptt=4)
+        mln = _mln("tbptt", tbptt=4)
+        cg.fit(x, y)
+        mln.fit(DataSet(x, y))
+        cg_p = [cg.params["lstm"], cg.params["out"]]
+        for got, want in zip(cg_p, mln.params):
+            for k in want:
+                np.testing.assert_allclose(
+                    np.asarray(got[k]), np.asarray(want[k]),
+                    rtol=1e-4, atol=1e-5, err_msg=k)
+        np.testing.assert_allclose(cg.get_score(), mln.get_score(),
+                                   rtol=1e-4)
+
+    def test_chunked_differs_from_full_bptt(self):
+        """Truncation must actually truncate (different update than full
+        backprop through all T steps)."""
+        x, y = _seq(b=2, t=12, seed=2)
+        tb = _cg("tbptt", tbptt=4)
+        tb.fit(x, y)
+        std = _cg("standard")
+        std.fit(x, y)
+        diffs = [float(np.max(np.abs(np.asarray(tb.params[n][k])
+                                     - np.asarray(std.params[n][k]))))
+                 for n in std.params for k in std.params[n]]
+        assert max(diffs) > 1e-6
+
+
+class TestTextGeneration:
+    def test_zoo_textgenlstm_generates_via_rnn_time_step(self):
+        """TextGenerationLSTM streams characters through rnn_time_step
+        (the reference zoo model's sampling loop)."""
+        from deeplearning4j_tpu.zoo.simple import TextGenerationLSTM
+        vocab = 11
+        net = TextGenerationLSTM(total_unique_characters=vocab).init()
+        rs = np.random.RandomState(0)
+        ch = rs.randint(0, vocab)
+        generated = []
+        for _ in range(8):
+            x = np.zeros((1, vocab), np.float32)
+            x[0, ch] = 1.0
+            probs = np.asarray(net.rnn_time_step(x))[0, -1]
+            assert probs.shape == (vocab,)
+            assert np.all(np.isfinite(probs))
+            np.testing.assert_allclose(probs.sum(), 1.0, rtol=1e-4)
+            ch = int(np.argmax(probs))
+            generated.append(ch)
+        assert len(generated) == 8

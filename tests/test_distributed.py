@@ -1,0 +1,47 @@
+"""Two-process multi-host test for parallel/distributed.py (VERDICT r1 #8).
+
+Spawns two real OS processes, each with 2 virtual CPU devices, forms the
+jax.distributed cluster through a local coordinator, and asserts a pod-mesh
+psum sums across the process boundary. CI-runnable, no TPU — the moral
+equivalent of the reference's Spark `local[N]` distributed tests
+(BaseSparkTest.java, SURVEY.md §4).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_WORKER = Path(__file__).with_name("_dist_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_pod_mesh_psum():
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(_WORKER.parents[1])
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, str(_WORKER), str(port), str(pid), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers hung:\n" + "\n".join(outs))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER_{pid}_OK psum=10.0" in out, out

@@ -195,9 +195,9 @@ def test_vae_gradients():
     vae = VariationalAutoencoder(n_in=6, n_out=3, encoder_layer_sizes=(8,),
                                  decoder_layer_sizes=(8,), activation="tanh",
                                  weight_init="xavier")
-    params = vae.init(jax.random.PRNGKey(0), dtype=np.float64)
+    params = vae.init(jax.random.PRNGKey(0))
     import jax.numpy as jnp
-    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64), params)
+
 
     def loss_fn(p):
         return vae.compute_score(p, jnp.asarray(x), train=False, rng=None)
@@ -215,7 +215,7 @@ def test_autoencoder_gradients():
                      weight_init="xavier", corruption_level=0.0)
     params = ae.init(jax.random.PRNGKey(1))
     import jax.numpy as jnp
-    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64), params)
+
 
     def loss_fn(p):
         return ae.compute_score(p, jnp.asarray(x), train=False, rng=None)

@@ -168,3 +168,72 @@ class TestKnnServer:
                 cli.knn(index=999, k=1)      # out of range
         finally:
             srv.stop()
+
+
+class TestModelSystemActivationPages:
+    def _train_conv(self, storage):
+        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                                  DenseLayer, OutputLayer)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                .weight_init("xavier")
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=3, stride=1,
+                                        activation="relu"))
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.set_listeners(
+            StatsListener(storage, session_id="conv_sess"),
+            ConvolutionalIterationListener(storage, frequency=2,
+                                           session_id="conv_sess"))
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 8, 8, 1).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+        for _ in range(4):
+            net.fit(x, y)
+        return net
+
+    def test_model_system_activation_endpoints(self):
+        storage = InMemoryStatsStorage()
+        self._train_conv(storage)
+        ui = UIServer(port=0)
+        try:
+            ui.attach(storage)
+            base = f"http://127.0.0.1:{ui.port}"
+            # HTML pages
+            for path, marker in (("/train/model", b"per-layer"),
+                                 ("/train/system", b"system"),
+                                 ("/train/activations", b"activations")):
+                page = urllib.request.urlopen(base + path, timeout=5).read()
+                assert marker in page, path
+            # model data: per-layer series with named groups + log ratios
+            d = json.loads(urllib.request.urlopen(
+                base + "/train/model/data?sid=conv_sess", timeout=5).read())
+            assert any("ConvolutionLayer" in g for g in d["series"])
+            some = next(iter(d["series"].values()))
+            assert len(some["iterations"]) == 4
+            assert len(some["logRatio"]) == 4
+            import math
+            assert any(isinstance(v, float) and not math.isnan(v)
+                       for v in some["logRatio"][1:])
+            # system data
+            s = json.loads(urllib.request.urlopen(
+                base + "/train/system/data?sid=conv_sess", timeout=5).read())
+            assert len(s["memRssMb"]) == 4 and s["memRssMb"][-1] > 0
+            # activations data: PNG grids for the conv layer
+            a = json.loads(urllib.request.urlopen(
+                base + "/train/activations/data?sid=conv_sess",
+                timeout=5).read())
+            assert a["images"], "no activation captures"
+            import base64
+            png = base64.b64decode(next(iter(a["images"].values())))
+            assert png[:8] == b"\x89PNG\r\n\x1a\n"
+        finally:
+            ui.stop()
