@@ -214,3 +214,109 @@ class MultipleEpochsIterator(DataSetIterator):
                 raise
             self.base.reset()
             return next(self.base)
+
+
+class InequalityHandling:
+    """What a JointParallelDataSetIterator consumer does when its producer
+    runs dry (parity: datasets/iterator/parallel/InequalityHandling.java)."""
+    PASS_NULL = "pass_null"
+    STOP_EVERYONE = "stop_everyone"
+    RESET = "reset"
+    RELOCATE = "relocate"
+
+
+class JointParallelDataSetIterator(DataSetIterator):
+    """Feeds N consumers (one per device/worker) from N producer iterators
+    (parity: datasets/iterator/parallel/JointParallelDataSetIterator.java —
+    per-consumer ``has_next_for``/``next_for``, plus plain iteration that
+    interleaves producers round-robin). Each producer is wrapped in an
+    AsyncDataSetIterator for background prefetch, matching the reference's
+    initializeIterators; dry producers follow the InequalityHandling policy."""
+
+    _EMPTY = object()
+
+    def __init__(self, iterators,
+                 inequality_handling=InequalityHandling.STOP_EVERYONE,
+                 buffer_size: int = 4, async_prefetch: bool = True):
+        if not iterators:
+            raise ValueError(
+                "You can't start ParallelDataSetIterator without input data")
+        self.producers = [AsyncDataSetIterator(it, queue_size=buffer_size)
+                          if async_prefetch else it for it in iterators]
+        self.inequality = inequality_handling
+        self._heads = [self._EMPTY] * len(self.producers)  # lookahead slots
+        self._stopped = False
+        self._cursor = 0
+
+    @property
+    def num_producers(self):
+        return len(self.producers)
+
+    def _check(self, consumer):
+        if consumer < 0 or consumer >= len(self.producers):
+            raise IndexError(f"Non-existent consumer {consumer} requested")
+
+    def _pull(self, consumer) -> bool:
+        """Fill the lookahead slot from the producer. True if data present."""
+        if self._heads[consumer] is not self._EMPTY:
+            return True
+        try:
+            self._heads[consumer] = next(self.producers[consumer])
+            return True
+        except StopIteration:
+            return False
+
+    def has_next_for(self, consumer: int) -> bool:
+        self._check(consumer)
+        if self._stopped:
+            return False
+        if self._pull(consumer):
+            return True
+        # producer dry — apply the inequality policy
+        if self.inequality == InequalityHandling.STOP_EVERYONE:
+            self._stopped = True
+            return False
+        if self.inequality == InequalityHandling.RESET:
+            self.producers[consumer].reset()
+            return self._pull(consumer)
+        if self.inequality == InequalityHandling.RELOCATE:
+            return any(self._pull(c) for c in range(len(self.producers)))
+        return False                                   # PASS_NULL
+
+    def next_for(self, consumer: int):
+        """The consumer's next DataSet, or None when its producer is dry
+        under PASS_NULL/STOP_EVERYONE (the reference returns null)."""
+        if not self.has_next_for(consumer):
+            return None
+        if self._heads[consumer] is not self._EMPTY:
+            item = self._heads[consumer]
+            self._heads[consumer] = self._EMPTY
+            return item
+        if self.inequality == InequalityHandling.RELOCATE:
+            for c in range(len(self.producers)):
+                if self._heads[c] is not self._EMPTY:
+                    item = self._heads[c]
+                    self._heads[c] = self._EMPTY
+                    return item
+        return None
+
+    # round-robin single-consumer view (DataSetIterator protocol)
+    def __next__(self):
+        n = len(self.producers)
+        for off in range(n):
+            c = (self._cursor + off) % n
+            if self.has_next_for(c):
+                self._cursor = (c + 1) % n
+                item = self.next_for(c)
+                if item is not None:
+                    return item
+            if self._stopped:
+                break
+        raise StopIteration
+
+    def reset(self):
+        for p in self.producers:
+            p.reset()
+        self._heads = [self._EMPTY] * len(self.producers)
+        self._stopped = False
+        self._cursor = 0

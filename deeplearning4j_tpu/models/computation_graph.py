@@ -390,6 +390,49 @@ class ComputationGraph:
         self._score = float(self._score)   # cache: host read is ~100ms on
         return self._score                 # tunneled TPU attachments
 
+    # ------------------------------------------------- external gradients
+    def backprop_external(self, inputs, epsilons):
+        """Parameter gradients from externally-supplied dL/d(output)
+        epsilons (parity: ComputationGraph.calcBackpropGradients(
+        externalEpsilons), used when this graph's outputs feed an external
+        computation — e.g. featurized transfer-learning workflows).
+        ``epsilons``: one array per network output, shaped like it.
+        Returns (grads, new_state) — grads include the l1/l2 regularization
+        term (this framework applies regularization in the loss, so an
+        external-epsilon step must add its gradient explicitly to match
+        fit())."""
+        inputs = [jnp.asarray(x) for x in inputs] \
+            if isinstance(inputs, (list, tuple)) else [jnp.asarray(inputs)]
+        epsilons = [jnp.asarray(e) for e in epsilons] \
+            if isinstance(epsilons, (list, tuple)) else [jnp.asarray(epsilons)]
+
+        def outs(params):
+            acts, new_state, _ = self._forward(params, self.state, inputs,
+                                               train=True, rng=None)
+            return [acts[n] for n in self.conf.network_outputs], new_state
+
+        _, vjp, new_state = jax.vjp(outs, self.params, has_aux=True)
+        (grads,) = vjp(epsilons)
+
+        def reg(params):
+            return sum((self.conf.nodes[n].layer.reg_loss(p)
+                        for n, p in params.items()), jnp.float32(0))
+
+        reg_grads = jax.grad(reg)(self.params)
+        grads = jax.tree_util.tree_map(jnp.add, grads, reg_grads)
+        return grads, new_state
+
+    def fit_external(self, inputs, epsilons):
+        """One updater step driven by external epsilons (the training half
+        of the externalEpsilons contract). Updates params, updater state and
+        layer state (e.g. batchnorm running stats) like fit()."""
+        grads, new_state = self.backprop_external(inputs, epsilons)
+        self.params, self.opt_state = self._dp_apply_updates(
+            self.params, self.opt_state, grads)
+        self.state = new_state
+        self.iteration += 1
+        return self
+
     # ------------------------------------------------------------------ rnn
     def rnn_time_step(self, *inputs):
         """Stateful streaming inference: feed one (or a few) timesteps,

@@ -21,6 +21,10 @@ from deeplearning4j_tpu.nlp.sentence_iterator import (
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord, VocabConstructor
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.distributed_word2vec import DistributedWord2Vec
+from deeplearning4j_tpu.nlp.cnn_sentence_iterator import (
+    CnnSentenceDataSetIterator, UnknownWordHandling,
+)
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
@@ -28,5 +32,5 @@ from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 __all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory",
            "CollectionSentenceIterator", "BasicLineIterator",
            "FileSentenceIterator", "VocabCache", "VocabWord",
-           "VocabConstructor", "Word2Vec", "ParagraphVectors", "Glove",
+           "VocabConstructor", "Word2Vec", "DistributedWord2Vec", "CnnSentenceDataSetIterator", "UnknownWordHandling", "ParagraphVectors", "Glove",
            "WordVectorSerializer"]

@@ -97,3 +97,47 @@ def test_glove():
               seed=7, sentences=_corpus(), subsampling=0)
     g.fit()
     assert g.similarity("cat", "kitten") > g.similarity("cat", "stocks")
+
+
+def test_distributed_word2vec_clusters_and_is_deterministic():
+    """DistributedWord2Vec (dl4j-spark-nlp parity: per-partition training +
+    periodic table averaging) over the 8-CPU mesh: learns the same topic
+    structure as the single-device trainer and is run-to-run deterministic."""
+    from deeplearning4j_tpu.nlp import DistributedWord2Vec
+    from deeplearning4j_tpu.parallel.wrapper import default_mesh
+
+    mesh = default_mesh()
+    assert mesh.devices.size == 8      # conftest forces 8 virtual devices
+
+    def train():
+        return DistributedWord2Vec(
+            mesh=mesh, averaging_frequency=4, min_word_frequency=3,
+            layer_size=24, window_size=3, epochs=3, seed=7,
+            sentences=_corpus(), subsampling=0).fit()
+
+    w2v = train()
+    assert w2v.similarity("stocks", "market") > w2v.similarity("stocks", "kitten")
+    assert w2v.similarity("cat", "kitten") > w2v.similarity("cat", "market")
+
+    again = train()
+    np.testing.assert_array_equal(np.asarray(w2v.syn0), np.asarray(again.syn0))
+
+
+def test_distributed_word2vec_single_device_mesh():
+    """n=1 mesh: the pmean is the identity; training still works end-to-end
+    (the degenerate local case, like Spark local[1])."""
+    import jax
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.nlp import DistributedWord2Vec
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    w2v = DistributedWord2Vec(
+        mesh=mesh, min_word_frequency=3, layer_size=16, window_size=3,
+        epochs=2, seed=7, sentences=_corpus(), subsampling=0).fit()
+    assert w2v.similarity("stocks", "market") > w2v.similarity("stocks", "kitten")
+
+
+def test_distributed_word2vec_rejects_hs():
+    from deeplearning4j_tpu.nlp import DistributedWord2Vec
+    with pytest.raises(NotImplementedError):
+        DistributedWord2Vec(use_hierarchic_softmax=True, sentences=["a b"])
