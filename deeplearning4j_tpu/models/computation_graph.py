@@ -30,6 +30,10 @@ def _dtype_of(name):
             "float16": jnp.float16, "float64": jnp.float64}[name]
 
 
+from deeplearning4j_tpu.util.dtypes import (cast_floats as _cast_floats,
+                                             restore_dtypes as _restore_dtypes)
+
+
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -95,6 +99,8 @@ class ComputationGraph:
         acts: Dict[str, Any] = {}
         new_state = dict(state)
         new_carries = dict(carries) if carries is not None else None
+        if gc.compute_dtype:
+            params = _cast_floats(params, _dtype_of(gc.compute_dtype))
         for i, n in enumerate(self.conf.network_inputs):
             x = inputs[i]
             if gc.compute_dtype:
@@ -125,6 +131,12 @@ class ComputationGraph:
                 if st is not None:
                     new_state[name] = st
             acts[name] = y
+        if gc.compute_dtype:
+            # persistent state (BN stats) keeps its storage dtype
+            new_state = {
+                k: _restore_dtypes(v, state[k])
+                if k in state and state[k] is not None else v
+                for k, v in new_state.items()}
         return acts, new_state, new_carries
 
     def _loss(self, params, state, inputs, labels, rng, masks=None,

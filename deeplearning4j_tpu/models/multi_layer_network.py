@@ -33,6 +33,10 @@ def _dtype_of(name):
             "float16": jnp.float16, "float64": jnp.float64}[name]
 
 
+from deeplearning4j_tpu.util.dtypes import (cast_floats as _cast_floats,
+                                             restore_dtypes as _restore_dtypes)
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         conf.finalize()
@@ -95,7 +99,9 @@ class MultiLayerNetwork:
         new_carries)."""
         gc = self.conf.global_conf
         if gc.compute_dtype:
-            x = x.astype(_dtype_of(gc.compute_dtype))
+            cdt = _dtype_of(gc.compute_dtype)
+            x = x.astype(cdt)
+            params = _cast_floats(params, cdt)
         n = len(self.layers) if upto is None else upto
         new_states = list(state)
         new_carries = list(carries) if carries is not None else None
@@ -111,6 +117,10 @@ class MultiLayerNetwork:
                 new_states[i] = st if st is not None else state[i]
             if x.ndim == 2:
                 mask = None  # sequence collapsed to per-example
+        if gc.compute_dtype:
+            # keep persistent layer state (e.g. BN running stats) at its
+            # storage dtype so dtypes are stable across steps
+            new_states = _restore_dtypes(new_states, list(state))
         return x, new_states, new_carries
 
     def _loss(self, params, state, x, y, rng, mask_f, mask_l, carries=None):

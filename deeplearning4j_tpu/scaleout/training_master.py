@@ -149,7 +149,12 @@ class SharedTrainingMaster(TrainingMaster):
     def __init__(self, threshold: float = 1e-3, min_threshold: float = 1e-5,
                  threshold_step: float = 1e-5, shake_frequency: int = 0,
                  workers: int = 2, batch_size_per_worker: int = 16,
-                 learning_rate: Optional[float] = None):
+                 learning_rate: Optional[float] = None, mesh=None,
+                 capacity_fraction: float = 0.05):
+        """``mesh``: when given, workers are REAL mesh devices and the whole
+        encode→exchange→apply cycle runs as one compiled shard_map program
+        (threshold messages summed with lax.psum over ICI) instead of the
+        host-side logical-replica loop — see execute_training_collective."""
         super().__init__()
         self.threshold = threshold
         self.min_threshold = min_threshold
@@ -158,9 +163,14 @@ class SharedTrainingMaster(TrainingMaster):
         self.workers = workers
         self.batch_size_per_worker = batch_size_per_worker
         self.learning_rate = learning_rate
+        self.mesh = mesh
+        self.capacity_fraction = capacity_fraction
         self._net = None
         self._acc: Optional[EncodedGradientsAccumulator] = None
         self._grad_fn = None
+        self._collective_fn = None
+        self._residuals = None
+        self._thresholds = None
         self._unravel = None
         self._n_params = None
 
@@ -187,13 +197,123 @@ class SharedTrainingMaster(TrainingMaster):
 
         self._grad_fn = jax.jit(grad)
 
+    # ------------------------------------------------- collective exchange
+    def _build_collective_epoch(self, net, n, unravel, capacity):
+        """The Strom-2015 cycle as ONE shard_map program: per device —
+        local grad on its batch shard, residual add, threshold encode,
+        psum the sparse messages (≡ every worker applying every peer's
+        message exactly once), apply, adapt threshold. Replicas stay
+        bit-identical because each applies the same summed message; the
+        residual and threshold remain per-worker state, as in the
+        reference's per-executor EncodingHandler."""
+        from functools import partial as _partial
+        try:
+            from jax import shard_map
+        except ImportError:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from deeplearning4j_tpu.parallel.compression import (
+            threshold_encode, threshold_decode)
+        mesh = self.mesh
+        step = jnp.float32(self.threshold_step)
+        min_thr = jnp.float32(self.min_threshold)
+
+        # residual/threshold are PER-DEVICE state (the reference keeps one
+        # EncodingHandler per executor): leading device axis, sharded in and
+        # out, persisted across execute_training calls by the caller
+        @_partial(shard_map, mesh=mesh,
+                  in_specs=(P(), P("data"), P("data"), P(None, "data"),
+                            P(None, "data"), P()),
+                  out_specs=(P(), P("data"), P("data"), P()),
+                  check_vma=False)
+        def epoch(vec, residual, threshold, xs, ys, lr):
+            residual = residual[0]          # (1, n) shard → (n,)
+            threshold = threshold[0]
+
+            def body(carry, inp):
+                vec, residual, threshold = carry
+                x, y = inp
+                loss, g = jax.value_and_grad(
+                    lambda v: net._loss(unravel(v), net.state, x, y, None,
+                                        None, None)[0])(vec)
+                u = lr * g + residual
+                idx, vals, count = threshold_encode(u, threshold, capacity)
+                msg = threshold_decode(idx, vals, n)
+                residual = u - msg
+                vec = vec - jax.lax.psum(msg, "data")
+                # EncodingHandler._adapt: raise when saturated, decay when
+                # under a quarter full (per worker, as per executor in the
+                # reference)
+                threshold = jnp.where(
+                    count >= capacity, threshold + step,
+                    jnp.where(count < capacity // 4,
+                              jnp.maximum(min_thr, threshold - step),
+                              threshold))
+                return (vec, residual, threshold), loss
+            (vec, residual, threshold), losses = jax.lax.scan(
+                body, (vec, residual, threshold), (xs, ys))
+            return (vec, residual[None], threshold[None],
+                    jax.lax.pmean(losses.mean(), "data"))
+
+        return jax.jit(epoch)
+
+    def execute_training_collective(self, net, data):
+        """Mesh path: stack the (already per-worker-sized) minibatches into
+        (S, B_global, ...) with B_global sharded over the mesh and run the
+        whole exchange compiled (no host round trips)."""
+        flat, unravel = ravel_pytree(net.params)
+        n = int(flat.shape[0])
+        n_dev_state = self.mesh.devices.size
+        capacity = max(1, min(n, int(n * self.capacity_fraction)))
+        if self._collective_fn is None or self._net is not net:
+            self._net = net
+            self._collective_fn = self._build_collective_epoch(
+                net, n, unravel, capacity)
+            self._unravel = unravel
+            # per-device Strom state, carried ACROSS execute_training calls
+            # (epoch boundaries must not drop accumulated sub-threshold mass)
+            self._residuals = jnp.zeros((n_dev_state, n), jnp.float32)
+            self._thresholds = jnp.full((n_dev_state,), self.threshold,
+                                        jnp.float32)
+        lr = self.learning_rate
+        if lr is None:
+            upd = net.conf.global_conf.updater
+            lr = getattr(upd, "learning_rate", 0.01)
+        n_dev = self.mesh.devices.size
+        batches = [ds if isinstance(ds, DataSet) else DataSet(*ds)
+                   for ds in data]
+        from deeplearning4j_tpu.scaleout.cluster import repartition
+        batches = repartition(batches, self.batch_size_per_worker * n_dev)
+        # drop a trailing ragged batch (shard_map needs equal shards)
+        full = [b for b in batches
+                if b.features.shape[0] == self.batch_size_per_worker * n_dev]
+        if not full:
+            raise ValueError(
+                f"not enough data for one global batch of "
+                f"{self.batch_size_per_worker * n_dev}")
+        xs = jnp.asarray(np.stack([b.features for b in full]))
+        ys = jnp.asarray(np.stack([b.labels for b in full]))
+        vec, self._residuals, self._thresholds, loss = self._collective_fn(
+            flat, self._residuals, self._thresholds, xs, ys,
+            jnp.float32(lr))
+        self.threshold = float(jnp.mean(self._thresholds))  # summary only
+        net.params = self._unravel(vec)
+        net.iteration += len(full)
+        net._score = loss
+        return net
+
     def execute_training(self, net, data):
         """Round-robins minibatches over per-worker model replicas; each
         worker computes its gradient on ITS replica, broadcasts the encoded
         update, and applies every pending update (its own + peers') to its
         replica exactly once — SharedTrainingWrapper.run semantics. Replicas
         stay in sync because the exchange is synchronous (SURVEY.md §5:
-        async Aeron staleness intentionally not reproduced)."""
+        async Aeron staleness intentionally not reproduced).
+
+        With a ``mesh``, routes to execute_training_collective (the
+        compiled shard_map exchange — the production path)."""
+        if self.mesh is not None:
+            return self.execute_training_collective(net, data)
         if self._acc is None or self._net is not net:
             self._setup(net)
         lr = self.learning_rate

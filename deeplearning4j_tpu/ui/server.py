@@ -61,7 +61,9 @@ async function refresh(){
   const sel=document.getElementById('sess');
   const sids=await (await fetch('train/sessions')).json();
   if(sel.options.length!=sids.length){
-    sel.innerHTML=sids.map(s=>`<option>${s}</option>`).join('');
+    sel.innerHTML='';
+    sids.forEach(s=>{const o=document.createElement('option');
+      o.textContent=s; sel.appendChild(o);});
   }
   if(!sel.value) return;
   const ov=await (await fetch('train/overview?sid='+sel.value)).json();
@@ -83,7 +85,8 @@ setInterval(refresh, 2000); refresh();
 
 _NAV = ('<p><a href="/train">overview</a> | <a href="/train/model">model</a>'
         ' | <a href="/train/system">system</a>'
-        ' | <a href="/train/activations">activations</a></p>')
+        ' | <a href="/train/activations">activations</a>'
+        ' | <a href="/tsne">t-SNE</a></p>')
 
 _CHART_JS = """
 function line(id, xs, ys, color){
@@ -107,8 +110,11 @@ function line(id, xs, ys, color){
 async function pickSession(){
   const sel=document.getElementById('sess');
   const sids=await (await fetch('/train/sessions')).json();
-  if(sel.options.length!=sids.length)
-    sel.innerHTML=sids.map(s=>`<option>${s}</option>`).join('');
+  if(sel.options.length!=sids.length){
+    sel.innerHTML='';
+    sids.forEach(s=>{const o=document.createElement('option');
+      o.textContent=s; sel.appendChild(o);});
+  }
   return sel.value;
 }
 """
@@ -169,6 +175,44 @@ async function refresh(){{
   line('bps', d.iterations, d.batchesPerSec, '#c44');
   document.getElementById('info').textContent=
     ` device: ${{d.device||'?'}}, backend: ${{d.backend||'?'}}`;
+}}
+setInterval(refresh, 3000); refresh();
+</script></body></html>"""
+
+_TSNE_PAGE = f"""<!DOCTYPE html>
+<html><head><title>DL4J-TPU t-SNE</title>{_STYLE}</head><body>
+<h1>t-SNE embedding</h1>{_NAV}
+<div class="card">Session: <select id="sess"></select>
+ <span id="meta"></span></div>
+<div class="card"><canvas id="sc" style="height:480px"></canvas></div>
+<script>
+async function refresh(){{
+  const sel=document.getElementById('sess');
+  const sids=await (await fetch('/tsne/sessions')).json();
+  if(sel.options.length!=sids.length)
+    sel.innerHTML=sids.map(s=>`<option>${{s}}</option>`).join('');
+  if(!sel.value) return;
+  const d=await (await fetch('/tsne/coords?sid='+sel.value)).json();
+  const c=document.getElementById('sc');
+  c.width=c.clientWidth; c.height=c.clientHeight;
+  const g=c.getContext('2d');
+  g.clearRect(0,0,c.width,c.height);
+  const pts=d.coords||[];
+  if(!pts.length) return;
+  document.getElementById('meta').textContent=` ${{pts.length}} points`;
+  const xs=pts.map(p=>p[0]), ys=pts.map(p=>p[1]);
+  const x0=Math.min(...xs), x1=Math.max(...xs);
+  const y0=Math.min(...ys), y1=Math.max(...ys);
+  const px=x=>20+(x-x0)/((x1-x0)||1)*(c.width-40);
+  const py=y=>c.height-20-(y-y0)/((y1-y0)||1)*(c.height-40);
+  g.font='10px sans-serif';
+  pts.forEach((p,i)=>{{
+    g.fillStyle='#2a6cc4'; g.beginPath();
+    g.arc(px(p[0]),py(p[1]),2.5,0,7); g.fill();
+    if(d.labels&&d.labels[i]!=null){{
+      g.fillStyle='#333'; g.fillText(d.labels[i],px(p[0])+4,py(p[1]));
+    }}
+  }});
 }}
 setInterval(refresh, 3000); refresh();
 </script></body></html>"""
@@ -305,6 +349,16 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
             self._json(out)
             return
+        if u.path == "/tsne":
+            self._html(_TSNE_PAGE)
+            return
+        if u.path == "/tsne/sessions":
+            self._json(sorted(self.server.ui.tsne_sessions))
+            return
+        if u.path == "/tsne/coords":
+            sid = parse_qs(u.query).get("sid", [None])[0]
+            self._json(self.server.ui.tsne_sessions.get(sid, {"coords": []}))
+            return
         if u.path == "/train/activations":
             self._html(_ACTIVATIONS_PAGE)
             return
@@ -321,6 +375,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         u = urlparse(self.path)
+        if u.path == "/tsne/upload":
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(n).decode())
+                self.server.ui.upload_tsne(      # validates/normalizes
+                    str(payload.get("sessionId", "default")),
+                    payload.get("coords", []), payload.get("labels"))
+            except Exception as e:  # noqa: BLE001 — bad payload → 400
+                self._json({"error": f"invalid tsne payload: {e}"}, 400)
+                return
+            self._json({"status": "ok"})
+            return
         if u.path != "/remote":
             self._json({"error": "not found"}, 404)
             return
@@ -351,6 +417,7 @@ class UIServer:
     def __init__(self, port: int = 9000):
         self.storages: List[StatsStorage] = []
         self.remote_storage: Optional[StatsStorage] = None
+        self.tsne_sessions: dict = {}     # sid -> {coords, labels}
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
         self._httpd.ui = self
         self.port = self._httpd.server_address[1]
@@ -381,6 +448,17 @@ class UIServer:
         if attach:
             self.attach(self.remote_storage)
         return self.remote_storage
+
+    def upload_tsne(self, session_id: str, coords, labels=None):
+        """Register a 2-D embedding for the /tsne page (parity: the
+        TsneModule's /tsne/upload + /tsne/coords routes; typically fed from
+        plot/tsne.BarnesHutTsne output)."""
+        import numpy as np
+        coords = np.asarray(coords, float)
+        self.tsne_sessions[session_id] = {
+            "coords": coords[:, :2].tolist(),
+            "labels": None if labels is None else [str(l) for l in labels]}
+        return self
 
     def stop(self):
         self._httpd.shutdown()

@@ -1,0 +1,31 @@
+"""Mixed-precision pytree helpers shared by both network containers.
+
+The containers' ``compute_dtype`` contract: master params and persistent
+layer state (e.g. batchnorm running stats) are stored in the configured
+storage dtype (f32 by default); forward/backward run in the compute dtype
+(params cast at forward entry — grads come back in the storage dtype through
+the autodiff of the cast); state written back keeps its storage dtype so
+shapes/dtypes are stable across steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floats(tree, dt):
+    """Cast floating leaves of a pytree to ``dt``."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dt)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a, tree)
+
+
+def restore_dtypes(new_tree, old_tree):
+    """Leaf-wise: cast ``new_tree`` back to ``old_tree``'s dtypes (persistent
+    state keeps its storage dtype under mixed-precision compute)."""
+    return jax.tree_util.tree_map(
+        lambda new, old: new.astype(old.dtype)
+        if hasattr(new, "dtype") and hasattr(old, "dtype") else new,
+        new_tree, old_tree)

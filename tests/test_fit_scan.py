@@ -102,3 +102,58 @@ class TestCGFitScan:
                     rtol=1e-5, atol=1e-6, err_msg=f"{name}/{key}")
         np.testing.assert_allclose(scanned.get_score(), seq.get_score(),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestMixedPrecision:
+    def test_bf16_compute_keeps_f32_master_params(self):
+        """compute_dtype='bfloat16': forward/backward run in bf16 (params
+        cast inside _forward), master params and BN running stats stay f32,
+        training still learns."""
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                                  BatchNormalization)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(1e-2)).weight_init("xavier")
+                .compute_dtype("bfloat16")
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=3,
+                                        has_bias=False))
+                .layer(BatchNormalization(activation="relu"))
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 8, 8, 1).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 16)]
+        l0 = net.score(x=x, y=y)
+        for _ in range(20):
+            net.fit(x, y)
+        assert net.score(x=x, y=y) < l0
+        import jax
+        for leaf in jax.tree_util.tree_leaves(net.params):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+        for leaf in jax.tree_util.tree_leaves(net.state):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                         jnp.floating):
+                assert leaf.dtype == jnp.float32, leaf.dtype
+
+    def test_bf16_compute_on_graph(self):
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        g = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-2))
+             .weight_init("xavier").compute_dtype("bfloat16")
+             .graph_builder().add_inputs("in")
+             .set_input_types(InputType.feed_forward(6))
+             .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+             .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "h"))
+        cg = ComputationGraph(g.set_outputs("out").build()).init()
+        xs, ys = _batches(1)
+        cg.fit(xs[0], ys[0])
+        assert np.isfinite(cg.get_score())
+        import jax
+        for leaf in jax.tree_util.tree_leaves(cg.params):
+            assert leaf.dtype == jnp.float32

@@ -187,3 +187,45 @@ class TestClusterSPI:
         total_out = np.sort(np.concatenate(
             [np.asarray(b.features).ravel() for b in out]))
         np.testing.assert_allclose(total_in, total_out)
+
+
+class TestCollectiveSharedMaster:
+    """SharedTrainingMaster with a mesh: the Strom-2015 threshold exchange
+    compiled as one shard_map program with psum'd sparse messages (the
+    production path; the logical-replica loop is the semantics demo)."""
+
+    def _batches(self, n_batches=8, bs=32):
+        ds = _toy_data(n=n_batches * bs)
+        f, l = np.asarray(ds.features), np.asarray(ds.labels)
+        return [DataSet(f[i * bs:(i + 1) * bs], l[i * bs:(i + 1) * bs])
+                for i in range(n_batches)]
+
+    def test_collective_exchange_learns_on_mesh(self):
+        from deeplearning4j_tpu.scaleout import (SharedTrainingMaster,
+                                                 ClusterMultiLayerNetwork)
+        from deeplearning4j_tpu.parallel.wrapper import default_mesh
+        mesh = default_mesh()
+        assert mesh.devices.size == 8
+        net = _toy_net()
+        master = SharedTrainingMaster(threshold=1e-3, learning_rate=0.1,
+                                      batch_size_per_worker=4, mesh=mesh)
+        cn = ClusterMultiLayerNetwork(net, master)
+        batches = self._batches()
+        before = np.mean(cn.score_examples(batches))
+        cn.fit(batches, epochs=5)
+        after = np.mean(cn.score_examples(batches))
+        assert after < before
+        assert net.iteration > 0
+
+    def test_collective_threshold_adapts(self):
+        from deeplearning4j_tpu.scaleout import (SharedTrainingMaster,
+                                                 ClusterMultiLayerNetwork)
+        from deeplearning4j_tpu.parallel.wrapper import default_mesh
+        net = _toy_net()
+        # huge threshold: nothing clears it, adapt must decay toward min
+        master = SharedTrainingMaster(threshold=10.0, min_threshold=1e-5,
+                                      threshold_step=0.5, learning_rate=0.05,
+                                      batch_size_per_worker=4,
+                                      mesh=default_mesh())
+        ClusterMultiLayerNetwork(net, master).fit(self._batches())
+        assert float(master.threshold) < 10.0

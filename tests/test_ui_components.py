@@ -1,0 +1,108 @@
+"""UI component library + t-SNE module tests (SURVEY §2 #33/#34 parity:
+deeplearning4j-ui-components, TsneModule)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.ui import components as cmp
+from deeplearning4j_tpu.ui import UIServer
+
+
+class TestComponents:
+    def test_chart_line_json_round_trip(self):
+        c = (cmp.ChartLine("loss", cmp.Style(width=300))
+             .add_series("train", [0, 1, 2], [3.0, 2.0, 1.0])
+             .add_series("val", [0, 1, 2], [3.5, 2.5, 1.5]))
+        back = cmp.Component.from_json(c.to_json())
+        assert isinstance(back, cmp.ChartLine)
+        assert back.title == "loss" and len(back.series) == 2
+        assert back.series[0]["y"] == [3.0, 2.0, 1.0]
+        assert back.style.width == 300
+
+    def test_series_length_mismatch_raises(self):
+        import pytest
+        with pytest.raises(ValueError):
+            cmp.ChartLine("bad").add_series("s", [1, 2], [1.0])
+
+    def test_histogram_and_scatter(self):
+        h = cmp.ChartHistogram("resid")
+        h.add_bin(0.0, 0.1, 5).add_bin(0.1, 0.2, 3)
+        back = cmp.Component.from_json(h.to_json())
+        assert back.series[0]["lower"] == 0.0 and back.series[1]["y"] == 3
+        s = cmp.ChartScatter("emb").add_series("pts", [1, 2], [3, 4])
+        assert "canvas" in s.render_html()
+
+    def test_table_text_div(self):
+        t = cmp.ComponentTable(["layer", "params"], [["conv", 500]])
+        d = cmp.ComponentDiv(t, cmp.ComponentText("hello"))
+        back = cmp.Component.from_json(d.to_json())
+        assert isinstance(back, cmp.ComponentDiv)
+        assert isinstance(back.children[0], cmp.ComponentTable)
+        assert back.children[0].rows == [["conv", "500"]]
+        html = back.render_html()
+        assert "conv" in html and "hello" in html
+
+    def test_stacked_area_and_bars(self):
+        sa = (cmp.ChartStackedArea("mem").add_series("a", [0, 1], [1, 1])
+              .add_series("b", [0, 1], [2, 2]))
+        assert "canvas" in sa.render_html()
+        hb = cmp.ChartHorizontalBar("counts").add_value("x", 3).add_value("y", 5)
+        assert cmp.Component.from_json(hb.to_json()).series[1]["value"] == 5
+        tl = cmp.ChartTimeline("phases").add_lane("etl", [(0, 1, "load")])
+        assert "load" in tl.render_html()
+
+    def test_render_page_is_standalone(self):
+        page = cmp.render_page(
+            cmp.ChartLine("l").add_series("s", [0, 1], [1, 2]),
+            cmp.ComponentText("done"))
+        assert page.startswith("<!DOCTYPE html>")
+        assert "done" in page
+
+
+class TestTsneModule:
+    def test_upload_and_serve(self):
+        ui = UIServer(port=0)
+        try:
+            rs = np.random.RandomState(0)
+            coords = rs.randn(20, 2)
+            ui.upload_tsne("emb1", coords, labels=[f"w{i}" for i in range(20)])
+            base = f"http://127.0.0.1:{ui.port}"
+            page = urllib.request.urlopen(base + "/tsne", timeout=5).read()
+            assert b"t-SNE" in page
+            sids = json.loads(urllib.request.urlopen(
+                base + "/tsne/sessions", timeout=5).read())
+            assert sids == ["emb1"]
+            d = json.loads(urllib.request.urlopen(
+                base + "/tsne/coords?sid=emb1", timeout=5).read())
+            assert len(d["coords"]) == 20 and d["labels"][3] == "w3"
+            # remote upload route (the reference TsneModule /tsne/upload)
+            body = json.dumps({"sessionId": "emb2",
+                               "coords": [[0, 1], [1, 0]],
+                               "labels": ["a", "b"]}).encode()
+            req = urllib.request.Request(base + "/tsne/upload", data=body,
+                                         method="POST")
+            assert json.loads(urllib.request.urlopen(
+                req, timeout=5).read())["status"] == "ok"
+            d2 = json.loads(urllib.request.urlopen(
+                base + "/tsne/coords?sid=emb2", timeout=5).read())
+            assert d2["coords"] == [[0, 1], [1, 0]]
+        finally:
+            ui.stop()
+
+    def test_tsne_pipeline_to_ui(self):
+        """plot/tsne output feeds the module (the BarnesHutTsne → UI flow)."""
+        from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+        ui = UIServer(port=0)
+        try:
+            rs = np.random.RandomState(1)
+            x = np.concatenate([rs.randn(10, 8) + 4, rs.randn(10, 8) - 4])
+            emb = BarnesHutTsne(max_iter=30, perplexity=5).fit_transform(x)
+            ui.upload_tsne("words", emb)
+            d = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/tsne/coords?sid=words",
+                timeout=5).read())
+            assert len(d["coords"]) == 20
+        finally:
+            ui.stop()
