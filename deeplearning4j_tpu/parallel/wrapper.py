@@ -76,18 +76,51 @@ class ParallelWrapper:
 
     def __init__(self, model, workers: Optional[int] = None,
                  averaging_frequency: int = 1, prefetch_buffer: int = 2,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, model_axis: str = "model"):
+        """``mesh`` may be 1-D ``('data',)`` (pure DP, the reference's
+        capability bar) or 2-D ``('data', 'model')`` — a TPU-idiomatic
+        extension: parameter output dims are sharded over the model axis
+        (tensor parallelism) while the batch shards over data; XLA/GSPMD
+        inserts the TP collectives. The reference has no TP (SURVEY §2
+        parallelism inventory)."""
         self.model = model
         self.mesh = mesh if mesh is not None else default_mesh(workers)
-        self.n_devices = self.mesh.devices.size
+        if "data" not in self.mesh.axis_names:
+            raise ValueError(
+                f"ParallelWrapper mesh needs a 'data' axis, got "
+                f"{self.mesh.axis_names}")
+        self.n_devices = self.mesh.shape["data"]   # batch shards over data
+        self.model_axis = model_axis if model_axis in self.mesh.axis_names \
+            else None
+        if self.model_axis is not None and averaging_frequency != 1:
+            raise ValueError(
+                "tensor parallelism (2-D mesh) requires "
+                "averaging_frequency=1 (per-step sync)")
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.prefetch_buffer = prefetch_buffer
         self._step_fn = None
 
     # ------------------------------------------------------------------ build
+    def _param_sharding(self, leaf):
+        """TP rule: shard the OUTPUT (last) dim of >=2-D kernels and 1-D
+        vectors over the model axis when divisible; replicate otherwise.
+        GSPMD propagates these shards through the graph and inserts the
+        collectives — annotation, not manual communication."""
+        if self.model_axis is None:
+            return NamedSharding(self.mesh, P())
+        m = self.mesh.shape[self.model_axis]
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                and leaf.shape[-1] % m == 0 and leaf.shape[-1] >= m:
+            return NamedSharding(
+                self.mesh, P(*([None] * (leaf.ndim - 1) + [self.model_axis])))
+        return NamedSharding(self.mesh, P())
+
     def _replicated(self, tree):
-        sharding = NamedSharding(self.mesh, P())
-        return jax.device_put(tree, sharding)
+        """Place params: replicated (pure DP) or TP-sharded (2-D mesh)."""
+        if self.model_axis is None:
+            return jax.device_put(tree, NamedSharding(self.mesh, P()))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._param_sharding(a)), tree)
 
     def _build_sync_step(self):
         """averaging_frequency == 1: jit with sharding annotations; XLA emits
@@ -107,6 +140,12 @@ class ParallelWrapper:
                                                           grads)
             return new_params, new_state, new_opt, loss
 
+        if self.model_axis is not None:
+            # TP x DP: params/opt were committed TP-sharded by _replicated
+            # and the batch is committed data-sharded in fit(); jit follows
+            # the committed input shardings and GSPMD inserts both the DP
+            # gradient all-reduce and the TP collectives.
+            return jax.jit(step, donate_argnums=(0, 1, 2))
         return jax.jit(
             step,
             in_shardings=(repl, repl, repl, data_sh, data_sh, None, data_sh,
@@ -170,11 +209,16 @@ class ParallelWrapper:
         if self.averaging_frequency == 1:
             if self._step_fn is None:
                 self._step_fn = self._build_sync_step()
+            data_sh = NamedSharding(self.mesh, P("data"))
             for _ in range(epochs):
                 if hasattr(data, "reset"):
                     data.reset()
                 for ds in data:
                     x, y, pad_mask, mf, ml = self._prepare(ds)
+                    if self.model_axis is not None:
+                        x, y, pad_mask, mf, ml = jax.tree_util.tree_map(
+                            lambda a: jax.device_put(jnp.asarray(a), data_sh),
+                            (x, y, pad_mask, mf, ml))
                     model.params, model.state, model.opt_state, loss = \
                         self._step_fn(model.params, model.state, model.opt_state,
                                       x, y, jnp.asarray(model.iteration, jnp.int32),

@@ -32,7 +32,7 @@ class GoogLeNet(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-2, momentum=0.9))
+             .updater(self.updater(Nesterovs(1e-2, momentum=0.9)))
              .weight_init("relu")
              .activation("relu")
              .graph_builder()
@@ -101,7 +101,7 @@ class InceptionResNetV1(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-3))
+             .updater(self.updater(Adam(1e-3)))
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")
@@ -223,7 +223,7 @@ class FaceNetNN4Small2(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-3))
+             .updater(self.updater(Adam(1e-3)))
              .weight_init("relu")
              .activation("relu")
              .graph_builder()

@@ -27,7 +27,7 @@ class ResNet50(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-1, momentum=0.9))
+             .updater(self.updater(Nesterovs(1e-1, momentum=0.9)))
              .weight_init("relu")
              .l2(1e-4)
              .graph_builder()

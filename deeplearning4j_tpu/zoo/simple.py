@@ -31,7 +31,7 @@ class LeNet(ZooModel):
         h, w, c = self.input_shape
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
-                .updater(Adam(1e-3))
+                .updater(self.updater(Adam(1e-3)))
                 .weight_init("xavier")
                 .list()
                 .layer(ConvolutionLayer(n_out=20, kernel_size=5, stride=1,
@@ -57,7 +57,7 @@ class SimpleCNN(ZooModel):
         h, w, c = self.input_shape
         b = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-3))
+             .updater(self.updater(Adam(1e-3)))
              .activation("relu")
              .weight_init("relu")
              .list())
@@ -83,7 +83,7 @@ class AlexNet(ZooModel):
         h, w, c = self.input_shape
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
-                .updater(Nesterovs(1e-2, momentum=0.9))
+                .updater(self.updater(Nesterovs(1e-2, momentum=0.9)))
                 .weight_init("distribution").dist("normal", 0.0, 0.01)
                 .activation("relu")
                 .l2(5e-4)
@@ -133,7 +133,7 @@ class VGG16(ZooModel):
         h, w, c = self.input_shape
         b = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-2, momentum=0.9))
+             .updater(self.updater(Nesterovs(1e-2, momentum=0.9)))
              .weight_init("relu")
              .list())
         _vgg_blocks(b, self._cfg)
@@ -158,7 +158,7 @@ class Darknet19(ZooModel):
         h, w, c = self.input_shape
         b = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-3))
+             .updater(self.updater(Adam(1e-3)))
              .weight_init("relu")
              .list())
 
@@ -203,7 +203,7 @@ class TextGenerationLSTM(ZooModel):
         vocab = self.input_shape[0]
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
-                .updater(Adam(1e-3))
+                .updater(self.updater(Adam(1e-3)))
                 .weight_init("xavier")
                 .gradient_normalization("ClipElementWiseAbsoluteValue", 10.0)
                 .list()

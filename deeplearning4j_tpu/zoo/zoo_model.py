@@ -28,6 +28,13 @@ class ZooModel:
         """Build the MultiLayerConfiguration / ComputationGraphConfiguration."""
         raise NotImplementedError
 
+    def updater(self, default):
+        """The training updater: the ``updater=`` constructor kwarg when
+        given, else the model's reference-parity default. (Overriding
+        ``conf.global_conf.updater`` after build has no effect — finalize()
+        copies updaters onto layers — so the kwarg is the supported way.)"""
+        return self.kwargs.get("updater") or default
+
     def init(self):
         """Build + initialize the network (parity: ZooModel.init)."""
         conf = self.conf()

@@ -1,0 +1,46 @@
+"""Data-parallel ResNet50 on CIFAR-10 through ParallelWrapper — the
+reference's flagship multi-device workflow (ParallelWrapper.Builder over a
+zoo ComputationGraph), TPU-native: one pjit-sharded train step, XLA emits
+the gradient all-reduce over ICI.
+
+Run (8 virtual devices on CPU):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+  PYTHONPATH=/root/repo python examples/resnet50_cifar10_dp.py
+"""
+
+import numpy as np
+
+
+def main():
+    import jax
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, default_mesh
+    from deeplearning4j_tpu.data.fetchers import load_cifar10
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+
+    mesh = default_mesh()
+    n = mesh.devices.size
+    print(f"mesh: {n} x {jax.devices()[0].device_kind}")
+
+    from deeplearning4j_tpu.nn.updaters import Adam
+    # the zoo default updater (Nesterov 0.1, reference parity) needs
+    # warmup+decay for from-scratch runs; override it for this short demo
+    cg = ResNet50(num_classes=10, input_shape=(32, 32, 3), seed=7,
+                  updater=Adam(1e-3)).init()
+    storage = InMemoryStatsStorage()
+    cg.set_listeners(StatsListener(storage, session_id="resnet50"))
+
+    x, y = load_cifar10(train=True, num_examples=64 * n)
+    pw = ParallelWrapper(cg, mesh=mesh, averaging_frequency=1)
+    pw.fit(ListDataSetIterator(DataSet(x, y), 16 * n), epochs=10)
+    print(f"loss after 10 epochs: {cg.get_score():.4f}")
+
+    ev = cg.evaluate([DataSet(x[:128], y[:128])])
+    print(f"train-subset accuracy: {ev.accuracy():.3f}")
+    print(f"collected {len(storage.get_all_updates('resnet50'))} stats reports")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Word2Vec skip-gram embeddings + nearest words + t-SNE visualization in
+the web UI (the reference's Word2Vec.Builder → words-nearest → UI workflow).
+
+Run: PYTHONPATH=/root/repo python examples/word2vec_embeddings.py
+"""
+
+
+def main():
+    import numpy as np
+    from deeplearning4j_tpu.nlp import Word2Vec
+    from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+    from deeplearning4j_tpu.ui import UIServer
+
+    animals = "cat dog kitten puppy pet fur paw tail".split()
+    finance = "stock market trade price share profit bank fund".split()
+    rs = np.random.RandomState(0)
+    sentences = []
+    for _ in range(400):
+        topic = animals if rs.rand() < 0.5 else finance
+        sentences.append(" ".join(rs.choice(topic, size=8)))
+
+    w2v = Word2Vec(min_word_frequency=5, layer_size=32, window_size=4,
+                   negative=5, epochs=3, seed=1, sentences=sentences,
+                   subsampling=0).fit()
+    print("nearest to 'cat':  ", w2v.words_nearest("cat", 4))
+    print("nearest to 'stock':", w2v.words_nearest("stock", 4))
+
+    words = [w for w in animals + finance if w2v.has_word(w)]
+    vecs = np.stack([w2v.word_vector(w) for w in words])
+    emb = BarnesHutTsne(max_iter=120, perplexity=5).fit_transform(vecs)
+
+    ui = UIServer.get_instance(port=0)
+    ui.upload_tsne("word2vec", emb, labels=words)
+    print(f"t-SNE view: http://127.0.0.1:{ui.port}/tsne  (ctrl-c to exit)")
+
+
+if __name__ == "__main__":
+    main()
