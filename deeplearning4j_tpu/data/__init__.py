@@ -1,7 +1,8 @@
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, ListDataSetIterator, ExistingDataSetIterator,
-    AsyncDataSetIterator, MultipleEpochsIterator,
+    AsyncDataSetIterator, AsyncMultiDataSetIterator,
+    MultipleEpochsIterator, JointParallelDataSetIterator, InequalityHandling,
 )
 from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
@@ -9,6 +10,8 @@ from deeplearning4j_tpu.data.normalizers import (
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
-    "ExistingDataSetIterator", "AsyncDataSetIterator", "MultipleEpochsIterator",
+    "ExistingDataSetIterator", "AsyncDataSetIterator",
+    "AsyncMultiDataSetIterator", "MultipleEpochsIterator",
+    "JointParallelDataSetIterator", "InequalityHandling",
     "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
 ]

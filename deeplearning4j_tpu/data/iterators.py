@@ -193,6 +193,13 @@ class AsyncDataSetIterator(DataSetIterator):
         self._stop = None
 
 
+# The async prefetch wrapper is payload-agnostic (it just pulls next(base)
+# on a worker thread), so the MultiDataSet variant the reference ships as a
+# separate class (AsyncMultiDataSetIterator.java, used by
+# ComputationGraph.fit) is the same implementation here.
+AsyncMultiDataSetIterator = AsyncDataSetIterator
+
+
 class MultipleEpochsIterator(DataSetIterator):
     """Replays a base iterator N times (parity: MultipleEpochsIterator)."""
 

@@ -90,6 +90,12 @@ class ParallelWrapper:
                 f"ParallelWrapper mesh needs a 'data' axis, got "
                 f"{self.mesh.axis_names}")
         self.n_devices = self.mesh.shape["data"]   # batch shards over data
+        if len(self.mesh.axis_names) > 1 and model_axis not in self.mesh.axis_names:
+            # a multi-axis mesh whose extra axis doesn't match would silently
+            # run pure DP with duplicate compute on the second axis
+            raise ValueError(
+                f"mesh has axes {self.mesh.axis_names} but model_axis="
+                f"{model_axis!r} matches none of them")
         self.model_axis = model_axis if model_axis in self.mesh.axis_names \
             else None
         if self.model_axis is not None and averaging_frequency != 1:

@@ -32,6 +32,13 @@ def main():
     ui = UIServer.get_instance(port=0)
     ui.upload_tsne("word2vec", emb, labels=words)
     print(f"t-SNE view: http://127.0.0.1:{ui.port}/tsne  (ctrl-c to exit)")
+    import os
+    if os.environ.get("DL4J_TPU_EXAMPLE_NONBLOCKING") != "1":
+        try:
+            import threading
+            threading.Event().wait()        # keep the UI server reachable
+        except KeyboardInterrupt:
+            pass
 
 
 if __name__ == "__main__":
