@@ -1,0 +1,103 @@
+"""Expert parallelism: capacity-based mixture-of-experts over a mesh axis.
+
+The reference has no MoE/expert parallelism (SURVEY §2 inventory); this is
+the TPU-idiomatic extension completing dp/tp/sp/pp/ep. The classic dense
+formulation (Shazeer et al.): top-1 gating builds static-shaped dispatch /
+combine tensors (tokens × experts × capacity) so the whole layer is three
+einsums plus the expert FFNs — no ragged shapes, XLA inserts the all-to-alls
+when the expert axis of the parameters and intermediate (E, C, D) tensors is
+sharded over the mesh's 'expert' axis.
+
+Tokens routed to a full expert (beyond ``capacity``) are dropped (output 0
+for that token — the standard GShard/Switch behavior); an auxiliary
+load-balancing loss keeps the router from collapsing onto one expert.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(rng, d_model: int, d_hidden: int, n_experts: int,
+                    dtype=jnp.float32):
+    kg, k1, k2 = jax.random.split(rng, 3)
+    scale_in = 1.0 / np.sqrt(d_model)
+    scale_h = 1.0 / np.sqrt(d_hidden)
+    return {
+        "Wg": jax.random.normal(kg, (d_model, n_experts), dtype) * scale_in,
+        "W1": jax.random.normal(k1, (n_experts, d_model, d_hidden), dtype)
+        * scale_in,
+        "b1": jnp.zeros((n_experts, d_hidden), dtype),
+        "W2": jax.random.normal(k2, (n_experts, d_hidden, d_model), dtype)
+        * scale_h,
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def shard_moe_params(params, mesh: Mesh, axis: str = "expert"):
+    """Expert-major leaves shard their leading (expert) dim over ``axis``;
+    the router is replicated."""
+    def place(name, a):
+        if name == "Wg":
+            return jax.device_put(a, NamedSharding(mesh, P()))
+        return jax.device_put(
+            a, NamedSharding(mesh, P(*([axis] + [None] * (a.ndim - 1)))))
+    return {k: place(k, v) for k, v in params.items()}
+
+
+def moe_ffw(params, x, capacity_factor: float = 1.25):
+    """Top-1 routed expert feed-forward.
+
+    x: (T, D) tokens. Returns (y, aux_loss) where y: (T, D) and aux_loss is
+    the Switch-style load-balancing penalty (mean fraction × mean prob per
+    expert, scaled by E).
+    """
+    T, D = x.shape
+    E = params["Wg"].shape[-1]
+    C = max(1, int(capacity_factor * T / E))
+
+    logits = x @ params["Wg"]                     # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)           # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)          # (T, E)
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot          # (T, E)
+    keep = onehot * (pos < C)                                  # capacity drop
+    pos_c = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=x.dtype)  # (T,E,C)
+    dispatch = keep[..., None] * pos_c                         # (T, E, C)
+    combine = dispatch * gate[:, None, None]                   # (T, E, C)
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)                # (E, C, D)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xe, params["W1"])
+                    + params["b1"][:, None, :])
+    ye = jnp.einsum("ech,ehd->ecd", h, params["W2"]) \
+        + params["b2"][:, None, :]
+    y = jnp.einsum("tec,ecd->td", combine, ye)                 # (T, D)
+
+    # Switch load-balancing aux loss
+    frac_tokens = onehot.mean(axis=0)                          # (E,)
+    frac_probs = probs.mean(axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return y, aux
+
+
+def moe_ffw_dense_reference(params, x):
+    """Every token through its argmax expert with NO capacity limit — the
+    unsharded oracle for tests (equals moe_ffw when capacity is ample)."""
+    probs = jax.nn.softmax(x @ params["Wg"], axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    W1 = params["W1"][expert]                     # (T, D, H)
+    b1 = params["b1"][expert]
+    W2 = params["W2"][expert]
+    b2 = params["b2"][expert]
+    h = jax.nn.gelu(jnp.einsum("td,tdh->th", x, W1) + b1)
+    y = jnp.einsum("th,thd->td", h, W2) + b2
+    return y * gate[:, None]

@@ -1,0 +1,106 @@
+"""Pipeline parallelism tests (TPU-idiomatic extension; no reference
+equivalent — oracle is the sequential application of the stages)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_forward, stack_stage_params, shard_stages, split_microbatches,
+    PipelineParallel,
+)
+
+S, F = 4, 16
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:S]), ("pipe",))
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["W"] + p["b"])
+
+
+def _stages(seed=0):
+    rs = np.random.RandomState(seed)
+    return [{"W": jnp.asarray(rs.randn(F, F) / np.sqrt(F), jnp.float32),
+             "b": jnp.asarray(rs.randn(F) * 0.1, jnp.float32)}
+            for _ in range(S)]
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+class TestPipelineForward:
+    def test_matches_sequential(self):
+        mesh = _mesh()
+        stages = _stages()
+        stacked = shard_stages(stack_stage_params(stages), mesh)
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(8, 3, F), jnp.float32)   # 8 microbatches
+        out = pipeline_forward(_stage_fn, stacked, x, mesh)
+        want = _sequential(stages, x.reshape(24, F)).reshape(8, 3, F)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fewer_microbatches_than_stages(self):
+        mesh = _mesh()
+        stages = _stages(2)
+        stacked = shard_stages(stack_stage_params(stages), mesh)
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 5, F), jnp.float32)
+        out = pipeline_forward(_stage_fn, stacked, x, mesh)
+        want = _sequential(stages, x.reshape(10, F)).reshape(2, 5, F)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_sequential(self):
+        """jax.grad through the schedule (shard_map + ppermute transpose)
+        must equal the sequential model's gradients."""
+        mesh = _mesh()
+        stages = _stages(3)
+        stacked_repl = stack_stage_params(stages)
+        rs = np.random.RandomState(4)
+        x = jnp.asarray(rs.randn(4, 3, F), jnp.float32)
+        tgt = jnp.asarray(rs.randn(12, F), jnp.float32)
+
+        def loss_pp(params):
+            out = pipeline_forward(_stage_fn, params, x, mesh)
+            return jnp.mean((out.reshape(12, F) - tgt) ** 2)
+
+        def loss_seq(params):
+            y = x.reshape(12, F)
+            for i in range(S):
+                p = jax.tree_util.tree_map(lambda a: a[i], params)
+                y = _stage_fn(p, y)
+            return jnp.mean((y - tgt) ** 2)
+
+        g_pp = jax.grad(loss_pp)(shard_stages(stacked_repl, mesh))
+        g_seq = jax.grad(loss_seq)(stacked_repl)
+        for k in ("W", "b"):
+            np.testing.assert_allclose(np.asarray(g_pp[k]),
+                                       np.asarray(g_seq[k]),
+                                       rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+class TestPipelineTrainer:
+    def test_trains(self):
+        mesh = _mesh()
+        pp = PipelineParallel(
+            _stage_fn, lambda y, t: jnp.mean((y - t) ** 2), _stages(5),
+            mesh, learning_rate=0.2, num_microbatches=4)
+        rs = np.random.RandomState(6)
+        x = rs.randn(16, F).astype(np.float32)
+        t = np.tanh(rs.randn(16, F)).astype(np.float32) * 0.5
+        losses = [float(pp.fit_batch(x, t)) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+        out = pp.forward(x)
+        assert out.shape == (16, F)
+
+    def test_bad_microbatch_split(self):
+        with pytest.raises(ValueError):
+            split_microbatches(np.zeros((10, 3)), 4)

@@ -18,7 +18,7 @@ RES = Path(__file__).with_name("resources")
 
 
 def _expected():
-    return json.load(open(RES / "golden_expected_v1.json"))
+    return json.loads((RES / "golden_expected_v1.json").read_text())
 
 
 class TestGoldenFormat:
@@ -52,7 +52,9 @@ class TestGoldenFormat:
         exp = _expected()
         net = guess_model(str(RES / "golden_mln_v1.zip"))
         x = np.asarray(exp["x_img"], np.float32)
+        n_classes = len(exp["mln_out"][0])
         rs = np.random.RandomState(0)
-        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, len(x))]
+        y = np.eye(n_classes, dtype=np.float32)[
+            rs.randint(0, n_classes, len(x))]
         net.fit(x, y)
         assert np.isfinite(net.get_score())
