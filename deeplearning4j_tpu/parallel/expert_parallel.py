@@ -15,8 +15,6 @@ load-balancing loss keeps the router from collapsing onto one expert.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 import jax
 import jax.numpy as jnp

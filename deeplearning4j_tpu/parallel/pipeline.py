@@ -25,16 +25,12 @@ from __future__ import annotations
 from functools import partial
 from typing import Callable
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.util.shmap import shard_map
 
 
 def stack_stage_params(per_stage_params):
@@ -61,6 +57,11 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x_microbatches,
     Returns (M, mb, F): the last stage's output per microbatch.
     """
     S = mesh.shape[axis]
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stages != S:
+        raise ValueError(
+            f"{n_stages} stages but the '{axis}' mesh axis has {S} devices "
+            "— each device holds exactly one stage")
     M = x_microbatches.shape[0]
     T = M + S - 1
 
@@ -122,6 +123,10 @@ class PipelineParallel:
         self.axis = axis
         self.lr = learning_rate
         self.num_microbatches = num_microbatches or mesh.shape[axis]
+        if len(per_stage_params) != mesh.shape[axis]:
+            raise ValueError(
+                f"{len(per_stage_params)} stages but the '{axis}' mesh axis "
+                f"has {mesh.shape[axis]} devices")
         self.params = shard_stages(stack_stage_params(per_stage_params),
                                    mesh, axis)
         self._step = None

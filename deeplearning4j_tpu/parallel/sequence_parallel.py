@@ -21,10 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.util.shmap import shard_map
 
 
 def _ring_attention_local(q, k, v, axis_name, causal):

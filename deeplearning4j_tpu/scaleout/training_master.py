@@ -207,10 +207,7 @@ class SharedTrainingMaster(TrainingMaster):
         residual and threshold remain per-worker state, as in the
         reference's per-executor EncodingHandler."""
         from functools import partial as _partial
-        try:
-            from jax import shard_map
-        except ImportError:  # pragma: no cover
-            from jax.experimental.shard_map import shard_map
+        from deeplearning4j_tpu.util.shmap import shard_map
         from jax.sharding import PartitionSpec as P
         from deeplearning4j_tpu.parallel.compression import (
             threshold_encode, threshold_decode)

@@ -189,8 +189,11 @@ _TSNE_PAGE = f"""<!DOCTYPE html>
 async function refresh(){{
   const sel=document.getElementById('sess');
   const sids=await (await fetch('/tsne/sessions')).json();
-  if(sel.options.length!=sids.length)
-    sel.innerHTML=sids.map(s=>`<option>${{s}}</option>`).join('');
+  if(sel.options.length!=sids.length){{
+    sel.innerHTML='';
+    sids.forEach(s=>{{const o=document.createElement('option');
+      o.textContent=s; sel.appendChild(o);}});
+  }}
   if(!sel.value) return;
   const d=await (await fetch('/tsne/coords?sid='+sel.value)).json();
   const c=document.getElementById('sc');

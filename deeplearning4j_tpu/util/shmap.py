@@ -1,0 +1,22 @@
+"""shard_map import shim.
+
+jax >= 0.7 exposes ``jax.shard_map`` (keyword ``check_vma``); older releases
+only have ``jax.experimental.shard_map.shard_map`` whose equivalent keyword
+is ``check_rep`` — a bare re-import would make every ``check_vma=`` call
+site TypeError on exactly the versions the fallback exists for, so the
+legacy path adapts the kwarg.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # noqa: F401
+except ImportError:  # pragma: no cover — legacy jax
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f=None, **kwargs):  # type: ignore[misc]
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return lambda fn: _legacy_shard_map(fn, **kwargs)
+        return _legacy_shard_map(f, **kwargs)

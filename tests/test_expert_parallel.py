@@ -4,7 +4,7 @@ dense expert application)."""
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from deeplearning4j_tpu.parallel.expert_parallel import (
     init_moe_params, shard_moe_params, moe_ffw, moe_ffw_dense_reference,
