@@ -27,7 +27,7 @@ from deeplearning4j_tpu.nn.layers.special import (
     CenterLossOutputLayer, Yolo2OutputLayer, FrozenLayer,
 )
 from deeplearning4j_tpu.nn.layers.attention import (
-    MultiHeadAttention, LayerNormalization,
+    MultiHeadAttention, LayerNormalization, PositionalEmbedding,
 )
 from deeplearning4j_tpu.nn.layers.pretrain import RBM
 
@@ -45,5 +45,5 @@ __all__ = [
     "RnnOutputLayer", "RnnLossLayer", "LastTimeStep",
     "GlobalPoolingLayer", "AutoEncoder", "VariationalAutoencoder",
     "CenterLossOutputLayer", "Yolo2OutputLayer", "FrozenLayer",
-    "MultiHeadAttention", "LayerNormalization", "RBM",
+    "MultiHeadAttention", "LayerNormalization", "PositionalEmbedding", "RBM",
 ]

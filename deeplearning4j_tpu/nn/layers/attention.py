@@ -29,8 +29,12 @@ def scaled_dot_product_attention(q, k, v, *, causal=False, mask=None,
     if (mask is None and q_offset == 0 and k_offset == 0
             and q.shape == k.shape and v.shape == q.shape
             and ops.helpers_enabled()):
-        from deeplearning4j_tpu.ops.flash_attention import supported
-        if supported(q.shape[1], q.shape[-1]):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            supported, MIN_SEQ_FOR_AUTO_ROUTE)
+        # interpreter mode (CPU tests) exercises the kernel at any length;
+        # compiled mode routes only where flash beats XLA (long sequences)
+        min_t = 0 if ops.interpret_mode() else MIN_SEQ_FOR_AUTO_ROUTE
+        if supported(q.shape[1], q.shape[-1], min_t=min_t):
             B, T, H, Dh = q.shape
             dt = q.dtype
             fold = lambda a: (a.transpose(0, 2, 1, 3)
@@ -137,3 +141,31 @@ class LayerNormalization(Layer):
         var = x.var(-1, keepdims=True)
         xn = (x - mean) * jax.lax.rsqrt(var + self.eps)
         return xn * params["gamma"] + params["beta"], state
+
+
+@register_layer
+@dataclass
+class PositionalEmbedding(Layer):
+    """Learned absolute positional embedding added to (B, T, C) inputs —
+    attention is permutation-invariant over a position's prefix, so a
+    transformer stack needs this (or rotary) to see token order. Companion
+    to MultiHeadAttention; no reference equivalent (the reference has no
+    attention at all)."""
+    n_in: int = 0
+    max_len: int = 512
+
+    def set_n_in(self, input_type):
+        if self.n_in == 0:
+            self.n_in = input_type.size or input_type.flat_size()
+
+    def init(self, rng, dtype=jnp.float32):
+        require_dims(self, n_in=self.n_in)
+        return {"P": jax.random.normal(rng, (self.max_len, self.n_in),
+                                       dtype) * 0.02}
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        T = x.shape[1]
+        if T > self.max_len:
+            raise ValueError(f"sequence length {T} exceeds "
+                             f"max_len={self.max_len}")
+        return x + params["P"][:T], state

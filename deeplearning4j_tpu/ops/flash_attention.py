@@ -31,11 +31,22 @@ def _pick_block(t):
     return None
 
 
-def supported(t, dh):
+# Auto-route threshold, measured on TPU v5e: XLA's fused-softmax attention
+# wins below T~4096 (0.1-0.6x at T<=2048); the flash kernel wins above
+# (1.06x @ 4096, 2.1x @ 8192) AND avoids the O(T^2) scores matrix that
+# starts pressuring HBM there. Direct flash_attention() calls are not
+# gated — only the layer seam's silent routing is.
+MIN_SEQ_FOR_AUTO_ROUTE = 4096
+
+
+def supported(t, dh, min_t: int = 0):
+    """Shape screen. ``min_t``: minimum sequence length (the layer seam
+    passes MIN_SEQ_FOR_AUTO_ROUTE so short sequences stay on the faster
+    XLA path; interpret-mode tests pass 0)."""
     # K and V are held fully in VMEM per (batch*head) row; screen out
     # shapes whose K/V exceed a conservative VMEM budget, and unaligned
     # head dims, so the seam's silent-fallback promise holds on real TPUs.
-    return (_pick_block(t) is not None and dh % 8 == 0
+    return (_pick_block(t) is not None and dh % 8 == 0 and t >= min_t
             and t * dh * 4 <= 4 * 1024 * 1024)
 
 

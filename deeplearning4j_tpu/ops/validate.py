@@ -70,6 +70,17 @@ def _time(fn, *args):
     return time_op(fn, *args)
 
 
+_MIN_MEASURABLE_S = 1e-7      # below RPC-jitter resolution → time is noise
+
+
+def _speedup(ref_s, ours_s):
+    """Ratio, or None when either side is below measurable resolution —
+    a near-zero denominator would fabricate million-x 'speedups'."""
+    if ref_s < _MIN_MEASURABLE_S or ours_s < _MIN_MEASURABLE_S:
+        return None
+    return round(ref_s / ours_s, 2)
+
+
 def _max_err(a, b):
     return float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b))))
 
@@ -127,9 +138,9 @@ def validate_lstm_case(b, t, h, rtol=2e-3, atol=2e-4, time_it=True):
         tgf = _time(g_fused, gate_in, rw, h0, c0)
         tgr = _time(g_ref, gate_in, rw, h0, c0)
         res.update(fwd_us=round(tf * 1e6, 1), fwd_scan_us=round(tr * 1e6, 1),
-                   fwd_speedup=round(tr / tf, 2),
+                   fwd_speedup=_speedup(tr, tf),
                    grad_us=round(tgf * 1e6, 1), grad_scan_us=round(tgr * 1e6, 1),
-                   grad_speedup=round(tgr / tgf, 2))
+                   grad_speedup=_speedup(tgr, tgf))
     return res
 
 
@@ -172,9 +183,9 @@ def validate_attention_case(bh, t, dh, causal, rtol=1e-2, atol=1e-3,
         tgf = _time(fa_g, q, k, v)
         tgr = _time(ref_g, q, k, v)
         res.update(fwd_us=round(tf * 1e6, 1), fwd_ref_us=round(tr * 1e6, 1),
-                   fwd_speedup=round(tr / tf, 2),
+                   fwd_speedup=_speedup(tr, tf),
                    grad_us=round(tgf * 1e6, 1), grad_ref_us=round(tgr * 1e6, 1),
-                   grad_speedup=round(tgr / tgf, 2))
+                   grad_speedup=_speedup(tgr, tgf))
     return res
 
 

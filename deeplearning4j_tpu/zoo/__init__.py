@@ -8,6 +8,7 @@ architectures (zoo/model/*.java) + ZooModel.initPretrained weight loading
 from deeplearning4j_tpu.zoo.zoo_model import ZooModel
 from deeplearning4j_tpu.zoo.simple import (
     LeNet, SimpleCNN, AlexNet, VGG16, VGG19, Darknet19, TextGenerationLSTM,
+    TinyTransformer,
 )
 from deeplearning4j_tpu.zoo.resnet import ResNet50
 from deeplearning4j_tpu.zoo.inception import (
@@ -15,5 +16,5 @@ from deeplearning4j_tpu.zoo.inception import (
 )
 
 __all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
-           "Darknet19", "TextGenerationLSTM", "ResNet50", "GoogLeNet",
+           "Darknet19", "TextGenerationLSTM", "TinyTransformer", "ResNet50", "GoogLeNet",
            "InceptionResNetV1", "FaceNetNN4Small2"]

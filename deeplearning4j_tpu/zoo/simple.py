@@ -213,3 +213,63 @@ class TextGenerationLSTM(ZooModel):
                                       loss="mcxent"))
                 .set_input_type(InputType.recurrent(vocab))
                 .build())
+
+
+class TinyTransformer(ZooModel):
+    """Decoder-only transformer char/LM model — a TPU-first extension (the
+    reference's zoo tops out at recurrent TextGenerationLSTM; attention does
+    not exist in it, SURVEY §5). Pre-LN blocks of causal MultiHeadAttention
+    (flash-attention Pallas kernel when supported) + GELU FFN, residual adds
+    via the same layer stack the rest of the framework uses."""
+    name = "tinytransformer"
+    default_input_shape = (64,)    # vocab size
+
+    def __init__(self, vocab_size: int = 64, n_layers: int = 2,
+                 d_model: int = 128, n_heads: int = 4, max_len: int = 512,
+                 seed: int = 123, **kwargs):
+        super().__init__(num_classes=vocab_size, seed=seed,
+                         input_shape=(vocab_size,), **kwargs)
+        self.n_layers = n_layers
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.max_len = max_len
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadAttention, LayerNormalization, PositionalEmbedding)
+        from deeplearning4j_tpu.nn.layers.rnn import RnnOutputLayer
+        vocab = self.input_shape[0]
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(self.updater(Adam(3e-4)))
+             .weight_init("xavier")
+             .graph_builder()
+             .add_inputs("tokens")
+             .set_input_types(InputType.recurrent(vocab)))
+        g.add_layer("embed", DenseLayer(n_out=self.d_model,
+                                        activation="identity"), "tokens")
+        g.add_layer("pos", PositionalEmbedding(max_len=self.max_len), "embed")
+        prev = "pos"
+        for i in range(self.n_layers):
+            g.add_layer(f"b{i}_ln1", LayerNormalization(), prev)
+            g.add_layer(f"b{i}_attn",
+                        MultiHeadAttention(n_out=self.d_model,
+                                           n_heads=self.n_heads, causal=True),
+                        f"b{i}_ln1")
+            g.add_vertex(f"b{i}_res1", ElementWiseVertex(op="add"),
+                         f"b{i}_attn", prev)
+            g.add_layer(f"b{i}_ln2", LayerNormalization(), f"b{i}_res1")
+            g.add_layer(f"b{i}_ff1", DenseLayer(n_out=4 * self.d_model,
+                                                activation="gelu"),
+                        f"b{i}_ln2")
+            g.add_layer(f"b{i}_ff2", DenseLayer(n_out=self.d_model,
+                                                activation="identity"),
+                        f"b{i}_ff1")
+            g.add_vertex(f"b{i}_res2", ElementWiseVertex(op="add"),
+                         f"b{i}_ff2", f"b{i}_res1")
+            prev = f"b{i}_res2"
+        g.add_layer("ln_f", LayerNormalization(), prev)
+        g.add_layer("out", RnnOutputLayer(n_out=vocab, activation="softmax",
+                                          loss="mcxent"), "ln_f")
+        return g.set_outputs("out").build()
