@@ -277,6 +277,10 @@ class Yolo2OutputLayer(Layer):
     lambda_no_obj: float = 0.5
     n_classes: int = 0
 
+    def __post_init__(self):
+        # JSON round-trips deliver lists; canonicalize so serde is stable
+        self.anchors = tuple(tuple(float(v) for v in a) for a in self.anchors)
+
     def has_params(self):
         return False
 

@@ -34,6 +34,10 @@ th{background:#eee}
 select{font-size:14px;padding:2px}
 </style></head><body>
 <h1>deeplearning4j_tpu &mdash; training overview</h1>
+<p><a href="/train">overview</a> | <a href="/train/model">model</a>
+ | <a href="/train/system">system</a>
+ | <a href="/train/activations">activations</a>
+ | <a href="/tsne">t-SNE</a></p>
 <div class="card">Session: <select id="sess"></select>
  <span id="meta"></span></div>
 <div class="card"><h2>Score vs iteration</h2><canvas id="score"></canvas></div>

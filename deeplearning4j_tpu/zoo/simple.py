@@ -196,6 +196,10 @@ class TextGenerationLSTM(ZooModel):
 
     def __init__(self, total_unique_characters: int = 77, seed: int = 123,
                  **kwargs):
+        # num_classes is the vocab for an LM — accept the generic zoo kwarg
+        total_unique_characters = kwargs.pop("num_classes",
+                                             total_unique_characters)
+        kwargs.pop("input_shape", None)
         super().__init__(num_classes=total_unique_characters, seed=seed,
                          input_shape=(total_unique_characters,), **kwargs)
 
@@ -227,6 +231,9 @@ class TinyTransformer(ZooModel):
     def __init__(self, vocab_size: int = 64, n_layers: int = 2,
                  d_model: int = 128, n_heads: int = 4, max_len: int = 512,
                  seed: int = 123, **kwargs):
+        # num_classes is the vocab for an LM — accept the generic zoo kwarg
+        vocab_size = kwargs.pop("num_classes", vocab_size)
+        kwargs.pop("input_shape", None)
         super().__init__(num_classes=vocab_size, seed=seed,
                          input_shape=(vocab_size,), **kwargs)
         self.n_layers = n_layers
