@@ -106,6 +106,9 @@ class DistributedWord2Vec(Word2Vec):
         key = jax.random.PRNGKey(self.seed)
 
         centers_all, contexts_all = self._make_pairs(seqs, rng)
+        if len(centers_all) == 0:          # nothing to train on (all
+            self._norm_cache = None        # sequences < 2 tokens)
+            return self
         n_dev = self.mesh.devices.size
         k = self.averaging_frequency
         bs = max(n_dev, self._effective_batch() // n_dev * n_dev)

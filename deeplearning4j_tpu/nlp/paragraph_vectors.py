@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nlp.word2vec import (
-    Word2Vec, _sg_neg_step, _cbow_neg_step, _sg_infer_step,
+    Word2Vec, _sg_neg_epoch, _cbow_neg_epoch, _sg_infer_step,
 )
 
 
@@ -58,71 +58,53 @@ class ParagraphVectors(Word2Vec):
         # doc table as syn0. PV-DM: cbow kernel with doc vector appended to
         # the context window (index into a concatenated [syn0; doc] table).
         if self.seq_algorithm == "dbow":
-            docs, words = [], []
-            for d, seq in enumerate(seqs):
-                docs.extend([d] * len(seq))
-                words.extend(seq.tolist())
-            docs = np.asarray(docs, np.int32)
-            words = np.asarray(words, np.int32)
+            # (doc, word) pairs, vectorized via the flat corpus view; each
+            # epoch runs in one compiled scan with the doc table as syn0
+            words, docs = self._flatten(seqs)
             n = len(docs)
             bs = self._effective_batch()
-            total = max(1, self.epochs * ((n + bs - 1) // bs))
+            total = self.epochs * max(1, (n + bs - 1) // bs)
             step_i = 0
             for ep in range(self.epochs):
-                order = rng.permutation(n)
-                for s in range(0, n, bs):
-                    sel = order[s:s + bs]
-                    lr = max(self.min_learning_rate,
-                             self.learning_rate * (1 - step_i / total))
-                    key, sub = jax.random.split(key)
-                    self.doc_vecs, self.syn1 = _sg_neg_step(
-                        self.doc_vecs, self.syn1, self._table,
-                        jnp.asarray(docs[sel]), jnp.asarray(words[sel]),
-                        jnp.float32(lr), sub, self.negative)
-                    step_i += 1
+                plan = self._epoch_plan(n, bs, rng.permutation(n), step_i,
+                                        total)
+                if plan is None:
+                    break
+                S, sel, w, lrs = plan
+                key, sub = jax.random.split(key)
+                self.doc_vecs, self.syn1 = _sg_neg_epoch(
+                    self.doc_vecs, self.syn1, self._table,
+                    jnp.asarray(docs[sel]), jnp.asarray(words[sel]),
+                    jnp.asarray(w), jnp.asarray(lrs), sub, self.negative)
+                step_i += S
             # also train word vectors (reference trainWordVectors=true default)
             super().fit()
         else:  # dm
             V = self.vocab.num_words()
-            W = 2 * self.window_size + 1  # context + doc slot
-            ctxs, masks, targets = [], [], []
-            for d, seq in enumerate(seqs):
-                n = len(seq)
-                wins = rng.randint(1, self.window_size + 1, size=n)
-                for i in range(n):
-                    w = wins[i]
-                    lo, hi = max(0, i - w), min(n, i + w + 1)
-                    window = [seq[j] for j in range(lo, hi) if j != i]
-                    row = np.zeros(W, np.int32)
-                    m = np.zeros(W, np.float32)
-                    row[0] = V + d  # doc vector slot
-                    m[0] = 1.0
-                    row[1:1 + len(window)] = window[:W - 1]
-                    m[1:1 + len(window)] = 1.0
-                    ctxs.append(row)
-                    masks.append(m)
-                    targets.append(seq[i])
-            ctxs = np.asarray(ctxs)
-            masks = np.asarray(masks)
-            targets = np.asarray(targets, np.int32)
+            # vectorized windows with the sequence id = document id, then a
+            # doc-vector slot prepended (index into [syn0; doc_vecs])
+            ctxs_w, masks_w, targets, sids = self._make_cbow_windows(
+                seqs, rng, with_sids=True)
+            ctxs = np.concatenate([(V + sids)[:, None], ctxs_w], axis=1)
+            masks = np.concatenate(
+                [np.ones((len(sids), 1), np.float32), masks_w], axis=1)
             combined = jnp.concatenate([self.syn0, self.doc_vecs], axis=0)
             n = len(targets)
             bs = self._effective_batch()
-            total = max(1, self.epochs * ((n + bs - 1) // bs))
+            total = self.epochs * max(1, (n + bs - 1) // bs)
             step_i = 0
             for ep in range(self.epochs):
-                order = rng.permutation(n)
-                for s in range(0, n, bs):
-                    sel = order[s:s + bs]
-                    lr = max(self.min_learning_rate,
-                             self.learning_rate * (1 - step_i / total))
-                    key, sub = jax.random.split(key)
-                    combined, self.syn1 = _cbow_neg_step(
-                        combined, self.syn1, self._table,
-                        jnp.asarray(ctxs[sel]), jnp.asarray(masks[sel]),
-                        jnp.asarray(targets[sel]), jnp.float32(lr), sub,
-                        self.negative)
-                    step_i += 1
+                plan = self._epoch_plan(n, bs, rng.permutation(n), step_i,
+                                        total)
+                if plan is None:
+                    break
+                S, sel, w, lrs = plan
+                key, sub = jax.random.split(key)
+                combined, self.syn1 = _cbow_neg_epoch(
+                    combined, self.syn1, self._table, jnp.asarray(ctxs[sel]),
+                    jnp.asarray(masks[sel]), jnp.asarray(targets[sel]),
+                    jnp.asarray(w), jnp.asarray(lrs), sub, self.negative)
+                step_i += S
             self.syn0 = combined[:V]
             self.doc_vecs = combined[V:]
         self._norm_cache = None

@@ -64,13 +64,6 @@ def _sg_neg_batch(syn0, syn1neg, table, centers, contexts, lr, key, negative,
 
 
 @partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
-def _sg_neg_step(syn0, syn1neg, table, centers, contexts, lr, key, negative):
-    """One-dispatch-per-batch variant (kept for ParagraphVectors)."""
-    return _sg_neg_batch(syn0, syn1neg, table, centers, contexts, lr, key,
-                         negative)
-
-
-@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
 def _sg_neg_epoch(syn0, syn1neg, table, centers_b, contexts_b, weights_b,
                   lrs, key, negative):
     """A whole epoch of skip-gram NEG batches in ONE compiled lax.scan —
@@ -141,11 +134,11 @@ def _sg_hs_step(syn0, syn1, centers, points, codes, code_mask, lr, *,
     return syn0, syn1
 
 
-@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
-def _cbow_neg_step(syn0, syn1neg, table, context_mat, context_mask, targets,
-                   lr, key, negative):
-    """CBOW: mean of context vectors predicts the target word.
-    context_mat: (B, W) int32 padded window indices; context_mask: (B, W)."""
+def _cbow_neg_batch(syn0, syn1neg, table, context_mat, context_mask, targets,
+                    lr, key, negative, weights=None):
+    """CBOW traceable core: mean of context vectors predicts the target.
+    context_mat: (B, W) int32 padded window indices; context_mask: (B, W);
+    weights: optional (B,) 0/1 row weights (0 = padding row)."""
     B, W = context_mat.shape
     ctx = syn0[context_mat]                      # (B, W, D)
     denom = jnp.maximum(context_mask.sum(-1, keepdims=True), 1.0)
@@ -153,6 +146,8 @@ def _cbow_neg_step(syn0, syn1neg, table, context_mat, context_mask, targets,
     u_pos = syn1neg[targets]
     s_pos = jax.nn.sigmoid((h * u_pos).sum(-1))
     g_pos = (1.0 - s_pos) * lr
+    if weights is not None:
+        g_pos = g_pos * weights
     dh = g_pos[:, None] * u_pos
     du_pos = g_pos[:, None] * h
     idx = jax.random.randint(key, (B, negative), 0, table.shape[0])
@@ -160,6 +155,8 @@ def _cbow_neg_step(syn0, syn1neg, table, context_mat, context_mask, targets,
     u_neg = syn1neg[negs]
     s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
     g_neg = -s_neg * lr
+    if weights is not None:
+        g_neg = g_neg * weights[:, None]
     dh = dh + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
     du_neg = g_neg[..., None] * h[:, None, :]
     # distribute dh back to context words (divided by window count)
@@ -167,6 +164,26 @@ def _cbow_neg_step(syn0, syn1neg, table, context_mat, context_mask, targets,
     syn0 = syn0.at[context_mat.reshape(-1)].add(dctx.reshape(B * W, -1))
     syn1neg = syn1neg.at[targets].add(du_pos)
     syn1neg = syn1neg.at[negs.reshape(-1)].add(du_neg.reshape(B * negative, -1))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
+def _cbow_neg_epoch(syn0, syn1neg, table, ctxs_b, masks_b, targets_b,
+                    weights_b, lrs, key, negative):
+    """A whole epoch of CBOW batches in ONE compiled lax.scan (see
+    _sg_neg_epoch). ctxs_b/masks_b: (S, B, W); targets_b/weights_b: (S, B);
+    lrs: (S,)."""
+    def body(carry, inp):
+        syn0, syn1neg, key = carry
+        c, m, t, w, lr = inp
+        key, sub = jax.random.split(key)
+        syn0, syn1neg = _cbow_neg_batch(syn0, syn1neg, table, c, m, t, lr,
+                                        sub, negative, weights=w)
+        return (syn0, syn1neg, key), jnp.float32(0)
+
+    (syn0, syn1neg, _), _ = jax.lax.scan(
+        body, (syn0, syn1neg, key), (ctxs_b, masks_b, targets_b, weights_b,
+                                     lrs))
     return syn0, syn1neg
 
 
@@ -349,22 +366,17 @@ class Word2Vec:
             if not self.use_hs:
                 # whole epoch in one compiled scan: shuffle + pad the last
                 # batch with zero-weight pairs, ship (S, B) batches once
-                S = (n_pairs + bs - 1) // bs
-                pad = S * bs - n_pairs
-                sel = np.concatenate([order, np.zeros(pad, order.dtype)])
-                w = np.concatenate([np.ones(n_pairs, np.float32),
-                                    np.zeros(pad, np.float32)])
-                lrs = np.maximum(
-                    self.min_learning_rate,
-                    self.learning_rate
-                    * (1.0 - (step_i + np.arange(S)) / total_steps))
+                plan = self._epoch_plan(n_pairs, bs, order, step_i,
+                                        total_steps)
+                if plan is None:
+                    break                      # nothing to train on
+                S, sel, w, lrs = plan
                 key, sub = jax.random.split(key)
                 self.syn0, self.syn1 = _sg_neg_epoch(
                     self.syn0, self.syn1, self._table,
-                    jnp.asarray(centers_all[sel].reshape(S, bs)),
-                    jnp.asarray(contexts_all[sel].reshape(S, bs)),
-                    jnp.asarray(w.reshape(S, bs)),
-                    jnp.asarray(lrs, jnp.float32), sub, self.negative)
+                    jnp.asarray(centers_all[sel]),
+                    jnp.asarray(contexts_all[sel]), jnp.asarray(w),
+                    jnp.asarray(lrs), sub, self.negative)
                 step_i += S
                 continue
             for s in range(0, n_pairs, bs):
@@ -382,9 +394,11 @@ class Word2Vec:
         self._norm_cache = None
         return self
 
-    def _make_cbow_windows(self, seqs, rng):
-        """Vectorized (contexts, mask, targets) window matrices: one numpy
-        pass per offset, mirroring _make_pairs."""
+    def _make_cbow_windows(self, seqs, rng, with_sids=False):
+        """Vectorized (contexts, mask, targets[, sequence ids]) window
+        matrices: one numpy pass per offset, mirroring _make_pairs.
+        ``with_sids`` also returns each kept row's sequence index
+        (ParagraphVectors uses it as the document id)."""
         W = self.window_size
         flat, sids = self._flatten(seqs)
         n = len(flat)
@@ -405,27 +419,50 @@ class Word2Vec:
                 ctxs[ri, W + d - 1] = flat[ri + d]
                 masks[ri, W + d - 1] = 1.0
         keep = masks.sum(axis=1) > 0
-        return ctxs[keep], masks[keep], flat[keep].astype(np.int32)
+        out = (ctxs[keep], masks[keep], flat[keep].astype(np.int32))
+        if with_sids:
+            out = out + (sids[keep].astype(np.int32),)
+        return out
+
+    def _epoch_plan(self, n, bs, order, step_i, total_steps, lr0=None):
+        """One epoch's scan inputs, or None when the corpus yields nothing
+        to train on (n == 0 — e.g. every sequence shorter than 2 tokens):
+        (S, (S,bs) padded selection, (S,bs) 0/1 pad weights, (S,) LR
+        schedule). Shared by every NEG epoch scan so the decay formula and
+        the empty-corpus guard live in exactly one place."""
+        if n == 0:
+            return None
+        S = (n + bs - 1) // bs
+        pad = S * bs - n
+        sel = np.concatenate([order, np.zeros(pad, order.dtype)])
+        w = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        lrs = np.maximum(
+            self.min_learning_rate,
+            (lr0 if lr0 is not None else self.learning_rate)
+            * (1.0 - (step_i + np.arange(S)) / max(total_steps, 1))
+        ).astype(np.float32)
+        return S, sel.reshape(S, bs), w.reshape(S, bs), lrs
 
     def _fit_cbow(self, seqs, rng, key):
-        """CBOW pass: batches of (context window, target)."""
+        """CBOW pass: each epoch's (window, target) batches run in one
+        compiled scan (same dispatch-amortization as the skip-gram path)."""
         ctxs, masks, targets = self._make_cbow_windows(seqs, rng)
         n = len(targets)
         bs = self._effective_batch()
-        total = max(1, self.epochs * ((n + bs - 1) // bs))
+        total = self.epochs * max(1, (n + bs - 1) // bs)
         step_i = 0
         for ep in range(self.epochs):
             order = np.random.RandomState(self.seed + ep).permutation(n)
-            for s in range(0, n, bs):
-                sel = order[s:s + bs]
-                lr = max(self.min_learning_rate,
-                         self.learning_rate * (1.0 - step_i / total))
-                key, sub = jax.random.split(key)
-                self.syn0, self.syn1 = _cbow_neg_step(
-                    self.syn0, self.syn1, self._table, jnp.asarray(ctxs[sel]),
-                    jnp.asarray(masks[sel]), jnp.asarray(targets[sel]),
-                    jnp.float32(lr), sub, self.negative)
-                step_i += 1
+            plan = self._epoch_plan(n, bs, order, step_i, total)
+            if plan is None:
+                return
+            S, sel, w, lrs = plan
+            key, sub = jax.random.split(key)
+            self.syn0, self.syn1 = _cbow_neg_epoch(
+                self.syn0, self.syn1, self._table, jnp.asarray(ctxs[sel]),
+                jnp.asarray(masks[sel]), jnp.asarray(targets[sel]),
+                jnp.asarray(w), jnp.asarray(lrs), sub, self.negative)
+            step_i += S
 
     # ------------------------------------------------------------ query API
     def word_vector(self, word) -> Optional[np.ndarray]:

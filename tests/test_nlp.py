@@ -58,10 +58,12 @@ def test_word2vec_hierarchical_softmax():
 
 def test_word2vec_cbow():
     w2v = Word2Vec(min_word_frequency=3, layer_size=24, window_size=3,
-                   epochs=3, seed=7, sentences=_corpus(), subsampling=0,
+                   epochs=8, seed=7, sentences=_corpus(), subsampling=0,
                    elements_learning_algorithm="cbow")
     w2v.fit()
-    assert w2v.similarity("stocks", "market") > w2v.similarity("stocks", "kitten")
+    # margin, not a hair's breadth: topic structure must be clear
+    assert w2v.similarity("stocks", "market") > \
+        w2v.similarity("stocks", "kitten") + 0.1
 
 
 def test_word2vec_serialization(tmp_path):
