@@ -118,14 +118,18 @@ class ComputationGraph:
             mask = None
             if masks and node.inputs and node.inputs[0] in masks:
                 mask = masks[node.inputs[0]]
+            p_n = params.get(name, {})
+            if (train and node.layer.weight_noise is not None
+                    and lrng is not None):
+                p_n = node.layer.weight_noise.apply(
+                    p_n, jax.random.fold_in(lrng, 0x5eed))
             if (new_carries is not None
                     and hasattr(node.layer, "apply_with_carry")):
                 y, c = node.layer.apply_with_carry(
-                    params.get(name, {}), ins[0], new_carries.get(name),
-                    mask=mask)
+                    p_n, ins[0], new_carries.get(name), mask=mask)
                 new_carries[name] = c
             else:
-                y, st = node.layer.apply(params.get(name, {}), ins[0],
+                y, st = node.layer.apply(p_n, ins[0],
                                          state.get(name), train=train,
                                          rng=lrng, mask=mask)
                 if st is not None:
@@ -154,8 +158,12 @@ class ComputationGraph:
             pre_act_input = acts[node.inputs[0]]
             lrng = None if rng is None else jax.random.fold_in(rng, 10000 + oi)
             lm = None if not label_masks else label_masks[oi]
+            p_out = params.get(out_name, {})
+            if node.layer.weight_noise is not None and lrng is not None:
+                p_out = node.layer.weight_noise.apply(
+                    p_out, jax.random.fold_in(lrng, 0x5eed))
             total = total + node.layer.compute_score(
-                params.get(out_name, {}), pre_act_input, labels[oi], lm,
+                p_out, pre_act_input, labels[oi], lm,
                 train=True, rng=lrng)
         for name, p in params.items():
             total = total + self.conf.nodes[name].layer.reg_loss(p)

@@ -108,11 +108,15 @@ class MultiLayerNetwork:
         for i in range(n):
             l = self.layers[i]
             lrng = None if rng is None else jax.random.fold_in(rng, i)
+            p_i = params[i]
+            if train and l.weight_noise is not None and lrng is not None:
+                p_i = l.weight_noise.apply(
+                    p_i, jax.random.fold_in(lrng, 0x5eed))
             if new_carries is not None and hasattr(l, "apply_with_carry"):
-                x, c = l.apply_with_carry(params[i], x, new_carries[i], mask=mask)
+                x, c = l.apply_with_carry(p_i, x, new_carries[i], mask=mask)
                 new_carries[i] = c
             else:
-                x, st = l.apply(params[i], x, state[i], train=train, rng=lrng,
+                x, st = l.apply(p_i, x, state[i], train=train, rng=lrng,
                                 mask=mask)
                 new_states[i] = st if st is not None else state[i]
             if x.ndim == 2:
@@ -130,8 +134,12 @@ class MultiLayerNetwork:
             params, state, x, train=True, rng=rng, mask=mask_f, carries=carries,
             upto=len(self.layers) - 1)
         lrng = None if rng is None else jax.random.fold_in(rng, len(self.layers) - 1)
+        p_out = params[-1]
+        if out_layer.weight_noise is not None and lrng is not None:
+            p_out = out_layer.weight_noise.apply(
+                p_out, jax.random.fold_in(lrng, 0x5eed))
         if hasattr(out_layer, "compute_score"):
-            loss = out_layer.compute_score(params[-1], act, y, mask_l,
+            loss = out_layer.compute_score(p_out, act, y, mask_l,
                                            train=True, rng=lrng)
         else:
             raise ValueError(

@@ -424,7 +424,7 @@ class Word2Vec:
             out = out + (sids[keep].astype(np.int32),)
         return out
 
-    def _epoch_plan(self, n, bs, order, step_i, total_steps, lr0=None):
+    def _epoch_plan(self, n, bs, order, step_i, total_steps):
         """One epoch's scan inputs, or None when the corpus yields nothing
         to train on (n == 0 — e.g. every sequence shorter than 2 tokens):
         (S, (S,bs) padded selection, (S,bs) 0/1 pad weights, (S,) LR
@@ -438,7 +438,7 @@ class Word2Vec:
         w = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
         lrs = np.maximum(
             self.min_learning_rate,
-            (lr0 if lr0 is not None else self.learning_rate)
+            self.learning_rate
             * (1.0 - (step_i + np.arange(S)) / max(total_steps, 1))
         ).astype(np.float32)
         return S, sel.reshape(S, bs), w.reshape(S, bs), lrs

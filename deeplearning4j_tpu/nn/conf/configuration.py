@@ -54,16 +54,21 @@ class GlobalConf:
     gradient_normalization_threshold: float = 1.0
     dtype: str = "float32"               # param dtype
     compute_dtype: Optional[str] = None  # e.g. 'bfloat16' for MXU-friendly fwd/bwd
+    weight_noise: Optional[object] = None  # IWeightNoise (DropConnect/...)
 
     def defaults_dict(self):
         return {"activation": self.activation, "weight_init": self.weight_init,
                 "dist": self.dist, "bias_init": self.bias_init,
                 "updater": self.updater, "l1": self.l1, "l2": self.l2,
-                "dropout": self.dropout}
+                "dropout": self.dropout, "weight_noise": self.weight_noise}
 
     def to_dict(self):
-        d = dataclasses.asdict(self)
+        wn = self.weight_noise
+        self_no_wn = dataclasses.replace(self, weight_noise=None)
+        d = dataclasses.asdict(self_no_wn)
         d["updater"] = self.updater.to_dict()
+        if wn is not None:
+            d["weight_noise"] = wn.to_dict()
         return d
 
     @staticmethod
@@ -72,6 +77,9 @@ class GlobalConf:
         d["updater"] = Updater.from_dict(d["updater"])
         if d.get("dist") is not None:
             d["dist"] = tuple(d["dist"])
+        if d.get("weight_noise") is not None:
+            from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
+            d["weight_noise"] = IWeightNoise.from_dict(d["weight_noise"])
         return GlobalConf(**d)
 
 
@@ -135,6 +143,11 @@ class Builder:
 
     def compute_dtype(self, dt):
         self._g.compute_dtype = dt; return self
+
+    def weight_noise(self, wn):
+        """DropConnect / WeightNoise applied to every layer (parity:
+        NeuralNetConfiguration.Builder.weightNoise)."""
+        self._g.weight_noise = wn; return self
 
     def mini_batch(self, v):
         self._g.mini_batch = bool(v); return self

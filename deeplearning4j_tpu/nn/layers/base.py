@@ -38,7 +38,7 @@ def register_layer(cls):
 
 # fields every layer may inherit from the global NeuralNetConfiguration
 INHERITABLE = ("activation", "weight_init", "updater", "l1", "l2", "dropout",
-               "bias_init", "dist")
+               "bias_init", "dist", "weight_noise")
 
 
 @dataclass
@@ -55,6 +55,7 @@ class Layer:
     l1: Optional[float] = None
     l2: Optional[float] = None
     dropout: Optional[float] = None          # drop probability (NOT dl4j retain-prob)
+    weight_noise: Optional[object] = None    # IWeightNoise (DropConnect/...)
     constraints: Optional[tuple] = None      # e.g. ('maxnorm', 2.0)
 
     # ---- config protocol -------------------------------------------------
@@ -145,10 +146,13 @@ class Layer:
 
     # ---- serde -----------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
+        from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
         d = {}
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
             if isinstance(v, Updater):
+                v = v.to_dict()
+            elif isinstance(v, IWeightNoise):
                 v = v.to_dict()
             elif isinstance(v, Layer):  # wrappers (Bidirectional, Frozen)
                 v = v.to_dict()
@@ -169,6 +173,9 @@ class Layer:
                 continue
             if k == "updater" and isinstance(v, dict):
                 v = Updater.from_dict(v)
+            elif isinstance(v, dict) and "@noise" in v:
+                from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
+                v = IWeightNoise.from_dict(v)
             elif isinstance(v, dict) and "@type" in v:
                 v = layer_from_dict(v)
             elif isinstance(v, list):

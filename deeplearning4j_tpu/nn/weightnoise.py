@@ -1,0 +1,88 @@
+"""Weight noise — DropConnect and additive/multiplicative gaussian noise.
+
+Parity surface: reference nn/conf/weightnoise/ — IWeightNoise.java
+(getParameter applied to each param at forward time during training),
+DropConnect.java (Bernoulli weight retention) and WeightNoise.java
+(distribution noise, additive or multiplicative). Applied functionally in
+the containers' forward pass: the noised parameters exist only inside the
+traced step (no mutation), and gradients flow through the noise exactly as
+the reference's backprop does through its masked weights.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_NOISE_REGISTRY = {}
+
+
+def _register(cls):
+    _NOISE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class IWeightNoise:
+    """Base: apply(params, rng) -> noised params (bias keys 'b'/'bo'/...
+    are skipped unless apply_to_bias)."""
+    apply_to_bias: bool = False
+
+    def _noise_one(self, value, rng):
+        raise NotImplementedError
+
+    def apply(self, params: dict, rng):
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            sub_rng = jax.random.fold_in(rng, i)
+            if isinstance(v, dict):        # wrappers (Bidirectional: fwd/bwd)
+                out[k] = self.apply(v, sub_rng)
+            elif not hasattr(v, "ndim"):
+                out[k] = v
+            elif not self.apply_to_bias and k.startswith("b"):
+                out[k] = v
+            else:
+                out[k] = self._noise_one(v, sub_rng)
+        return out
+
+    # ---- serde ----------------------------------------------------------
+    def to_dict(self):
+        import dataclasses as dc
+        return {"@noise": type(self).__name__, **dc.asdict(self)}
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _NOISE_REGISTRY[d.pop("@noise")]
+        return cls(**d)
+
+
+@_register
+@dataclass
+class DropConnect(IWeightNoise):
+    """Bernoulli mask on weights (parity: DropConnect.java,
+    weightRetainProb). Inverted scaling keeps the expected activation equal
+    to the noiseless forward."""
+    weight_retain_prob: float = 0.5
+
+    def _noise_one(self, v, rng):
+        keep = jax.random.bernoulli(rng, self.weight_retain_prob, v.shape)
+        return jnp.where(keep, v / self.weight_retain_prob,
+                         jnp.zeros_like(v))
+
+
+@_register
+@dataclass
+class WeightNoise(IWeightNoise):
+    """Gaussian noise on weights (parity: WeightNoise.java with a
+    NormalDistribution): additive ``w + n`` or multiplicative ``w * n``
+    with n ~ N(mean, stddev)."""
+    mean: float = 0.0
+    stddev: float = 0.1
+    additive: bool = True
+
+    def _noise_one(self, v, rng):
+        n = self.mean + self.stddev * jax.random.normal(rng, v.shape, v.dtype)
+        return v + n if self.additive else v * n

@@ -144,3 +144,93 @@ def test_transfer_learning_helper_featurize():
     for _ in range(40):
         helper.fit_featurized(feats)
     assert frozen.score(DataSet(x, y)) < s_before
+
+
+class TestWeightNoise:
+    """Parity: nn/conf/weightnoise/ (IWeightNoise, DropConnect, WeightNoise)
+    — applied to params at forward time during training only."""
+
+    def _net(self, wn):
+        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.05))
+                .weight_init("xavier").weight_noise(wn).list()
+                .layer(DenseLayer(n_in=6, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n=64):
+        rs = np.random.RandomState(0)
+        x = rs.randn(n, 6).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        return x, y
+
+    def test_dropconnect_trains_and_inference_is_deterministic(self):
+        from deeplearning4j_tpu.nn.weightnoise import DropConnect
+        net = self._net(DropConnect(weight_retain_prob=0.8))
+        x, y = self._data()
+        l0 = net.score(x=x, y=y)
+        for _ in range(40):
+            net.fit(x, y)
+        assert net.score(x=x, y=y) < l0 * 0.8
+        # noise is train-only: repeated inference must be identical
+        np.testing.assert_array_equal(np.asarray(net.output(x)),
+                                      np.asarray(net.output(x)))
+
+    def test_weight_noise_changes_training_loss_stochastically(self):
+        from deeplearning4j_tpu.nn.weightnoise import WeightNoise
+        import jax.numpy as jnp
+        net = self._net(WeightNoise(stddev=0.3))
+        x, y = self._data(16)
+        # same params, two iterations: the train loss differs because the
+        # noise is resampled per step via the iteration-folded rng
+        l1, _ = net._loss(net.params, net.state, jnp.asarray(x),
+                          jnp.asarray(y),
+                          __import__("jax").random.PRNGKey(1), None, None)
+        l2, _ = net._loss(net.params, net.state, jnp.asarray(x),
+                          jnp.asarray(y),
+                          __import__("jax").random.PRNGKey(2), None, None)
+        assert float(l1) != float(l2)
+
+    def test_weight_noise_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.weightnoise import DropConnect
+        net = self._net(DropConnect(weight_retain_prob=0.7))
+        from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+        back = MultiLayerConfiguration.from_json(net.conf.to_json())
+        wn = back.layers[0].weight_noise
+        assert isinstance(wn, DropConnect)
+        assert wn.weight_retain_prob == 0.7
+
+    def test_weight_noise_reaches_output_layer_and_wrappers(self):
+        """Noise must hit the output layer's loss path and recurse into
+        wrapper layers' nested param dicts (Bidirectional)."""
+        import jax, jax.numpy as jnp
+        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import OutputLayer
+        from deeplearning4j_tpu.nn.weightnoise import WeightNoise, DropConnect
+
+        # output-layer-only net: two rng keys must give different train loss
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .weight_noise(WeightNoise(stddev=0.5)).list()
+                .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(8, 4), jnp.float32)
+        y = jnp.asarray(np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)])
+        l1, _ = net._loss(net.params, net.state, x, y,
+                          jax.random.PRNGKey(1), None, None)
+        l2, _ = net._loss(net.params, net.state, x, y,
+                          jax.random.PRNGKey(2), None, None)
+        assert float(l1) != float(l2), "output layer params never noised"
+
+        # nested dict recursion: DropConnect(0.5) must zero some leaves
+        dc = DropConnect(weight_retain_prob=0.5)
+        nested = {"fwd": {"W": jnp.ones((8, 8))}, "bwd": {"W": jnp.ones((8, 8))}}
+        noised = dc.apply(nested, jax.random.PRNGKey(0))
+        assert float(jnp.sum(noised["fwd"]["W"] == 0)) > 0
+        assert float(jnp.sum(noised["bwd"]["W"] == 0)) > 0
