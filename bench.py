@@ -75,23 +75,29 @@ def _tile_steps(a, k):
     return jnp.tile(a[None], (k,) + (1,) * a.ndim)
 
 
-def _time_fit_scan(model, x, y, k=64, repeats=5):
+def _time_fit_scan(model, x, y, k=64, repeats=5, score=None):
     """Seconds per train step via the device-resident fit_scan path: k steps
     run inside ONE compiled call; the fixed dispatch+read cost is removed by
     differencing a k-step run against a k/8-step run. The host-read RPC's
     latency is bimodal here, so the representative value is the MEDIAN of
-    ``repeats`` runs (min would pick the rare fast-path outlier)."""
+    ``repeats`` runs (min would pick the rare fast-path outlier).
+
+    ``model`` is anything with a ``fit_scan(xs, ys)`` (a container or a
+    ParallelWrapper); ``score`` returns the device scalar to sync on
+    (defaults to ``model._score``)."""
     import statistics
     from deeplearning4j_tpu.util.timing import host_sync
 
+    score = score or (lambda: model._score)
+
     def run(xs, ys):
         model.fit_scan(xs, ys)
-        host_sync(model._score)                 # compile + warm
+        host_sync(score())                      # compile + warm
         ts = []
         for _ in range(repeats):
             t0 = time.perf_counter()
             model.fit_scan(xs, ys)
-            host_sync(model._score)
+            host_sync(score())
             ts.append(time.perf_counter() - t0)
         return statistics.median(ts)
 
@@ -218,7 +224,12 @@ def bench_parallel_wrapper(batch_per_dev=128):
     """Data-parallel LeNet through ParallelWrapper over all attached devices
     (the driver attaches ONE chip, so this measures the sharded-step path at
     n=1; multi-device scaling is exercised on the 8-CPU virtual mesh in CI
-    and by __graft_entry__.dryrun_multichip)."""
+    and by __graft_entry__.dryrun_multichip).
+
+    Measures the device-resident multi-step DP path (ParallelWrapper.fit_scan
+    — all steps in one compiled sharded call), the same dispatch regime as
+    the container benches; the per-step host-dispatch number is reported as
+    ``per_step_dispatch_imgs_per_sec`` for comparison."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -236,24 +247,32 @@ def bench_parallel_wrapper(batch_per_dev=128):
 
     batch = batch_per_dev * n
     x_all, y_all = load_mnist(train=True, num_examples=batch, flatten=False)
+    x, y = jnp.asarray(x_all), jnp.asarray(y_all)
+    sec, _ = _time_fit_scan(pw, x, y, k=64, score=lambda: net._score)
+    ips = batch / sec
+
+    # the old regime: one jit dispatch per minibatch from host
     from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.data.iterators import ListDataSetIterator
     ds = DataSet(x_all, y_all)
-    pw.fit(ListDataSetIterator(ds, batch))     # warm: build + replicate
-    x, y, pad_mask, mf, ml = pw._prepare(ds)
-    step = pw._step_fn
-    st = {"p": net.params, "s": net.state, "o": net.opt_state, "loss": None}
+    pw_step = ParallelWrapper(MultiLayerNetwork(_lenet_conf()).init(),
+                              mesh=mesh, averaging_frequency=1)
+    pw_step.fit(ListDataSetIterator(ds, batch))   # warm: build + replicate
+    xp, yp, pad_mask, mf, ml = pw_step._prepare(ds)
+    step = pw_step._step_fn
+    m = pw_step.model
+    st = {"p": m.params, "s": m.state, "o": m.opt_state, "loss": None}
 
     def one(i):
         st["p"], st["s"], st["o"], st["loss"] = step(
-            st["p"], st["s"], st["o"], x, y, jnp.asarray(i, jnp.int32),
+            st["p"], st["s"], st["o"], xp, yp, jnp.asarray(i, jnp.int32),
             pad_mask, mf, ml)
 
-    sec = time_python_loop(one, 20, lambda: host_sync(st["loss"]))
-    ips = batch / sec
+    step_sec = time_python_loop(one, 20, lambda: host_sync(st["loss"]))
     return _emit(
-        f"ParallelWrapper LeNet DP (devices={n}, batch/dev={batch_per_dev})",
-        ips, "imgs/sec", BARS["pw_lenet"] * n)
+        f"ParallelWrapper LeNet DP (devices={n}, batch/dev={batch_per_dev}, "
+        "fit_scan)", ips, "imgs/sec", BARS["pw_lenet"] * n,
+        {"per_step_dispatch_imgs_per_sec": round(batch / step_sec, 1)})
 
 
 def bench_word2vec(n_tokens=200_000, vocab=2000, dim=100):

@@ -250,6 +250,10 @@ class ComputationGraph:
         MultiLayerNetwork.fit_scan). ``inputs_steps``/``labels_steps``:
         lists of arrays shaped (n_steps, batch, ...) — or single arrays for
         single-input/-output graphs."""
+        if getattr(self.conf, "backprop_type", "standard") == "tbptt":
+            raise ValueError(
+                "fit_scan runs full-sequence backprop; a graph configured "
+                "for truncated BPTT must use fit() (the tbptt chunking path)")
         if not isinstance(inputs_steps, (list, tuple)):
             inputs_steps = [inputs_steps]
         if not isinstance(labels_steps, (list, tuple)):

@@ -102,6 +102,7 @@ class ParallelWrapper:
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.prefetch_buffer = prefetch_buffer
         self._step_fn = None
+        self._scan_fn = None
 
     # ------------------------------------------------------------------ build
     def _param_sharding(self, leaf):
@@ -125,23 +126,34 @@ class ParallelWrapper:
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self._param_sharding(a)), tree)
 
+    def _grad_update(self, params, state, opt_state, x, y, rng,
+                     pad_mask=None, mf=None, ml=None):
+        """The single train-step math shared by every DP path (per-step and
+        scan, sync and averaging): grad of ``_dp_loss`` → ``_dp_apply_updates``.
+        RNG derivation stays with each caller (the sync paths fold the
+        iteration; the averaging paths additionally fold the device index so
+        divergent replicas draw independent dropout masks)."""
+        (loss, new_state), grads = jax.value_and_grad(
+            self.model._dp_loss, has_aux=True)(params, state, x, y, rng,
+                                               pad_mask, mf, ml)
+        new_params, new_opt = self.model._dp_apply_updates(params, opt_state,
+                                                           grads)
+        return new_params, new_state, new_opt, loss
+
+    def _fold_iteration(self, it):
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self.model.conf.global_conf.seed), it)
+
     def _build_sync_step(self):
         """averaging_frequency == 1: jit with sharding annotations; XLA emits
         the ICI all-reduce in backward."""
-        model = self.model
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
         data_sh = NamedSharding(mesh, P("data"))
 
         def step(params, state, opt_state, x, y, it, pad_mask, mf, ml):
-            rng = jax.random.fold_in(
-                jax.random.PRNGKey(model.conf.global_conf.seed), it)
-            (loss, new_state), grads = jax.value_and_grad(
-                model._dp_loss, has_aux=True)(params, state, x, y, rng,
-                                              pad_mask, mf, ml)
-            new_params, new_opt = model._dp_apply_updates(params, opt_state,
-                                                          grads)
-            return new_params, new_state, new_opt, loss
+            return self._grad_update(params, state, opt_state, x, y,
+                                     self._fold_iteration(it), pad_mask, mf, ml)
 
         if self.model_axis is not None:
             # TP x DP: params/opt were committed TP-sharded by _replicated
@@ -160,16 +172,7 @@ class ParallelWrapper:
         """averaging_frequency == k > 1: each device scans k local updates on
         its own divergent params, then params+opt state are pmean'd
         (parity: ParallelWrapper averaging + averageUpdatersState)."""
-        model = self.model
         mesh = self.mesh
-
-        def local_update(params, state, opt_state, x, y, pad_mask, rng):
-            (loss, new_state), grads = jax.value_and_grad(
-                model._dp_loss, has_aux=True)(params, state, x, y, rng,
-                                              pad_mask)
-            new_params, new_opt = model._dp_apply_updates(params, opt_state,
-                                                          grads)
-            return new_params, new_state, new_opt, loss
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), P(), P(), P(None, "data"), P(None, "data"),
@@ -182,11 +185,10 @@ class ParallelWrapper:
             def body(carry, inp):
                 params, state, opt_state, j = carry
                 x, y, pm = inp
-                rng = jax.random.fold_in(
-                    jax.random.PRNGKey(model.conf.global_conf.seed), it + j)
-                rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
-                p, s, o, loss = local_update(params, state, opt_state, x, y,
-                                             pm, rng)
+                rng = jax.random.fold_in(self._fold_iteration(it + j),
+                                         jax.lax.axis_index("data"))
+                p, s, o, loss = self._grad_update(params, state, opt_state,
+                                                  x, y, rng, pm)
                 return (p, s, o, j + 1), loss
 
             (params, state, opt_state, _), losses = jax.lax.scan(
@@ -198,6 +200,141 @@ class ParallelWrapper:
             return params, state, opt_state, jax.lax.pmean(losses.mean(), "data")
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_sync_scan(self):
+        """Device-resident multi-step sync DP: lax.scan over a leading step
+        axis INSIDE the sharded jit. One dispatch trains ``n_steps``
+        minibatches; XLA still inserts the per-step ICI gradient all-reduce
+        from the sharding annotations. This is the DP analogue of the
+        containers' ``fit_scan`` — per-step host dispatch (~ms on tunneled
+        attachments) is paid once per call instead of once per minibatch."""
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        step_data = NamedSharding(mesh, P(None, "data"))
+
+        def inner(params, state, opt_state, xs, ys, it0):
+            def body(carry, inp):
+                params, state, opt_state, it = carry
+                x, y = inp
+                p, s, o, loss = self._grad_update(
+                    params, state, opt_state, x, y, self._fold_iteration(it))
+                return (p, s, o, it + 1), loss
+
+            (p, s, o, _), losses = jax.lax.scan(
+                body, (params, state, opt_state, it0), (xs, ys))
+            return p, s, o, losses
+
+        if self.model_axis is not None:
+            # TP x DP: follow the committed input shardings (params TP-sharded
+            # by _replicated, batches data-sharded by fit_scan).
+            return jax.jit(inner, donate_argnums=(0, 1, 2))
+        return jax.jit(
+            inner,
+            in_shardings=(repl, repl, repl, step_data, step_data, None),
+            out_shardings=(repl, repl, repl, repl),
+            donate_argnums=(0, 1, 2))
+
+    def _build_averaging_scan(self):
+        """Device-resident averaging-frequency DP: outer scan over rounds,
+        inner scan over the k local (divergent-replica) steps of each round,
+        params+updater state pmean'd at every round boundary — the
+        reference's averaging semantics (ParallelWrapper.java:251-371) with
+        all rounds in one compiled call."""
+        mesh = self.mesh
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P(), P(None, None, "data"),
+                           P(None, None, "data"), P()),
+                 out_specs=(P(), P(), P(), P()),
+                 check_vma=False)
+        def step(params, state, opt_state, xs, ys, it0):
+            # xs leaves: (rounds, k, local_batch, ...)
+            def round_body(carry, inp):
+                params, state, opt_state, it = carry
+                xs_k, ys_k = inp
+
+                def body(carry2, inp2):
+                    params, state, opt_state, it = carry2
+                    x, y = inp2
+                    rng = jax.random.fold_in(self._fold_iteration(it),
+                                             jax.lax.axis_index("data"))
+                    p, s, o, loss = self._grad_update(params, state,
+                                                      opt_state, x, y, rng)
+                    return (p, s, o, it + 1), loss
+
+                (params, state, opt_state, it), losses = jax.lax.scan(
+                    body, (params, state, opt_state, it), (xs_k, ys_k))
+                params = jax.lax.pmean(params, "data")
+                state = jax.lax.pmean(state, "data")
+                opt_state = jax.lax.pmean(opt_state, "data")
+                return (params, state, opt_state, it), losses.mean()
+
+            (params, state, opt_state, _), losses = jax.lax.scan(
+                round_body, (params, state, opt_state, it0), (xs, ys))
+            return params, state, opt_state, jax.lax.pmean(losses, "data")
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit_scan(self, xs, ys):
+        """Train ``xs.shape[0]`` minibatches in ONE compiled sharded call.
+
+        ``xs``: (n_steps, batch, ...) features, ``ys``: (n_steps, batch, ...)
+        labels; ``batch`` must divide evenly over the mesh's data axis.
+        averaging_frequency=1 runs per-step gradient all-reduce;
+        k>1 requires n_steps % k == 0 and averages params/updater state every
+        k local steps (reference averaging semantics). Masked datasets go
+        through ``fit`` (the per-step path handles masks exactly)."""
+        model = self.model
+        if getattr(model.conf, "backprop_type", "standard") == "tbptt":
+            raise ValueError(
+                "fit_scan runs full-sequence backprop; a net configured for "
+                "truncated BPTT must use fit() (the tbptt chunking path)")
+        if model.params is None:
+            model.init()
+        xs = jax.tree_util.tree_map(jnp.asarray, xs)
+        ys = jax.tree_util.tree_map(jnp.asarray, ys)
+        lead = jax.tree_util.tree_leaves(xs)[0]
+        n_steps, batch = lead.shape[0], lead.shape[1]
+        for leaf in jax.tree_util.tree_leaves((xs, ys)):
+            if leaf.shape[:2] != (n_steps, batch):
+                raise ValueError(
+                    f"fit_scan leaves must share (n_steps, batch)="
+                    f"{(n_steps, batch)}; got {leaf.shape[:2]}")
+        if batch % self.n_devices != 0:
+            raise ValueError(
+                f"fit_scan batch {batch} must divide over {self.n_devices} "
+                "devices; pad the batch or use fit() (which pads exactly)")
+        model.params = self._replicated(model.params)
+        model.state = self._replicated(model.state)
+        model.opt_state = self._replicated(model.opt_state)
+        if self.averaging_frequency == 1:
+            if self._scan_fn is None:
+                self._scan_fn = self._build_sync_scan()
+            if self.model_axis is not None:
+                sh = NamedSharding(self.mesh, P(None, "data"))
+                xs = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, sh), xs)
+                ys = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, sh), ys)
+        else:
+            k = self.averaging_frequency
+            if n_steps % k != 0:
+                raise ValueError(
+                    f"n_steps={n_steps} must be a multiple of "
+                    f"averaging_frequency={k} on the fit_scan path")
+            reshape = lambda a: a.reshape((n_steps // k, k) + a.shape[1:])
+            xs = jax.tree_util.tree_map(reshape, xs)
+            ys = jax.tree_util.tree_map(reshape, ys)
+            if self._scan_fn is None:
+                self._scan_fn = self._build_averaging_scan()
+        model.params, model.state, model.opt_state, losses = self._scan_fn(
+            model.params, model.state, model.opt_state, xs, ys,
+            jnp.asarray(model.iteration, jnp.int32))
+        model.iteration += n_steps
+        model._score = losses[-1]
+        for lst in model.listeners:
+            lst.iteration_done(model, model.iteration, model.epoch)
+        return model
 
     # -------------------------------------------------------------------- fit
     def fit(self, data, epochs=1):

@@ -171,30 +171,34 @@ def test_resnet50_dp_smoke():
     assert np.isfinite(net.get_score())
 
 
+def _tp_net():
+    conf = (NeuralNetConfiguration.builder().seed(9).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _tp_data(n=32):
+    rs = np.random.RandomState(3)
+    x = rs.randn(n, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+    return x, y
+
+
 class TestTensorParallel:
     """TP x DP hybrid (2-D mesh) — a TPU-idiomatic extension beyond the
     reference's DP-only capability bar (SURVEY §2 parallelism inventory)."""
 
     def _net(self):
-        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
-        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
-        from deeplearning4j_tpu.nn.updaters import Sgd
-        from deeplearning4j_tpu.nn.conf.inputs import InputType
-        conf = (NeuralNetConfiguration.builder().seed(9).updater(Sgd(0.1))
-                .weight_init("xavier").list()
-                .layer(DenseLayer(n_out=32, activation="relu"))
-                .layer(DenseLayer(n_out=16, activation="relu"))
-                .layer(OutputLayer(n_out=4, activation="softmax",
-                                   loss="mcxent"))
-                .set_input_type(InputType.feed_forward(8))
-                .build())
-        return MultiLayerNetwork(conf).init()
+        return _tp_net()
 
     def _data(self, n=32):
-        rs = np.random.RandomState(3)
-        x = rs.randn(n, 8).astype(np.float32)
-        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
-        return x, y
+        return _tp_data(n)
 
     def test_tp_dp_matches_single_device(self):
         import jax
@@ -249,3 +253,89 @@ class TestTensorParallel:
                     ("data", "model"))
         with pytest.raises(ValueError):
             ParallelWrapper(self._net(), mesh=mesh, averaging_frequency=4)
+
+
+# ---------------------------------------------------------------- fit_scan DP
+
+def test_fit_scan_sync_matches_per_step_fit():
+    """Device-resident multi-step DP (one compiled call for all steps) must
+    produce bit-for-bit the same params as the per-step sync DP path — and
+    therefore the same as single-device training (covered transitively by
+    test_sync_dp_matches_single_device)."""
+    ds = _data()
+    batches = list(ds.batch_by(32))
+    xs = np.stack([np.asarray(b.features) for b in batches])
+    ys = np.stack([np.asarray(b.labels) for b in batches])
+
+    step_net = _net()
+    pw_step = ParallelWrapper(step_net, workers=8, averaging_frequency=1)
+    pw_step.fit(ListDataSetIterator(_data(), 32))
+
+    scan_net = _net()
+    pw_scan = ParallelWrapper(scan_net, workers=8, averaging_frequency=1)
+    pw_scan.fit_scan(xs, ys)
+
+    assert scan_net.iteration == step_net.iteration
+    for p1, p2 in zip(step_net.params, scan_net.params):
+        for k in p1:
+            np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                       rtol=1e-6, atol=1e-6, err_msg=k)
+
+
+def test_fit_scan_averaging_matches_per_chunk():
+    """averaging_frequency>1 through fit_scan must equal the per-chunk
+    averaging path (divergent local steps + pmean every k steps)."""
+    ds = _data(256)
+    batches = list(ds.batch_by(32))          # 256/32 = 8 steps, k=4 → 2 rounds
+    xs = np.stack([np.asarray(b.features) for b in batches])
+    ys = np.stack([np.asarray(b.labels) for b in batches])
+
+    step_net = _net(lr=0.1)
+    pw_step = ParallelWrapper(step_net, workers=8, averaging_frequency=4)
+    pw_step.fit(ListDataSetIterator(_data(256), 32))
+
+    scan_net = _net(lr=0.1)
+    pw_scan = ParallelWrapper(scan_net, workers=8, averaging_frequency=4)
+    pw_scan.fit_scan(xs, ys)
+
+    for p1, p2 in zip(step_net.params, scan_net.params):
+        for k in p1:
+            np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_fit_scan_validates_shapes():
+    net = _net()
+    pw = ParallelWrapper(net, workers=8, averaging_frequency=1)
+    x = np.zeros((4, 30, 4), np.float32)     # 30 % 8 != 0
+    y = np.zeros((4, 30, 3), np.float32)
+    with pytest.raises(ValueError):
+        pw.fit_scan(x, y)
+    pw4 = ParallelWrapper(_net(), workers=8, averaging_frequency=4)
+    x = np.zeros((6, 32, 4), np.float32)     # 6 % 4 != 0
+    y = np.zeros((6, 32, 3), np.float32)
+    with pytest.raises(ValueError):
+        pw4.fit_scan(x, y)
+
+
+def test_fit_scan_tp_dp_matches_single_device():
+    """fit_scan over a 2-D (data, model) mesh — TP params + sharded batch —
+    must match single-device training step for step."""
+    import jax
+    from jax.sharding import Mesh
+
+    x, y = _tp_data()
+    ref = _tp_net()
+    for i in range(0, 32, 16):
+        ref.fit(DataSet(x[i:i + 16], y[i:i + 16]))
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "model"))
+    net = _tp_net()
+    pw = ParallelWrapper(net, mesh=mesh)
+    pw.fit_scan(x.reshape(2, 16, 8), y.reshape(2, 16, 4))
+
+    for p_tp, p_ref in zip(net.params, ref.params):
+        for k in p_ref:
+            np.testing.assert_allclose(
+                np.asarray(p_tp[k]), np.asarray(p_ref[k]),
+                rtol=1e-4, atol=1e-5, err_msg=k)
