@@ -121,12 +121,13 @@ def _time_fit_scan(model, x, y, k=64, repeats=5, score=None):
     flops = None
     try:
         import jax.numpy as jnp
-        # XLA cost analysis counts a lax.scan body ONCE regardless of trip
-        # count, so lowering the 1-step program gives per-step FLOPs.
+        # Lower an EXPLICIT single-step program (k=1 tile) so per-step FLOPs
+        # never depend on how cost_analysis accounts scan trip counts.
+        xf, yf = _tile_steps(x, 1), _tile_steps(y, 1)
         flops = _cost_flops(model._scan_fit, model.params, model.state,
                             model.opt_state,
-                            x1 if isinstance(model.params, list) else [x1],
-                            y1 if isinstance(model.params, list) else [y1],
+                            xf if isinstance(model.params, list) else [xf],
+                            yf if isinstance(model.params, list) else [yf],
                             jnp.asarray(0, jnp.int32))
     except Exception:
         pass
@@ -139,50 +140,69 @@ def bench_lenet(batch=128):
     import jax.numpy as jnp
     from __graft_entry__ import _lenet_conf
     from deeplearning4j_tpu import MultiLayerNetwork
-    from deeplearning4j_tpu.data.fetchers import load_mnist
+    from deeplearning4j_tpu.data.fetchers import load_mnist, data_source
 
-    net = MultiLayerNetwork(_lenet_conf()).init()
     x_all, y_all = load_mnist(train=True, num_examples=batch, flatten=False)
     x, y = jnp.asarray(x_all), jnp.asarray(y_all)
-    sec, flops = _time_fit_scan(net, x, y, k=256)
-    ips = batch / sec
-    return _emit(f"LeNet-MNIST train (batch={batch}, 1 chip, fit_scan)", ips,
-                 "imgs/sec", BARS["lenet"],
-                 {"mfu": _mfu(flops, 1.0 / sec)})
+    out = None
+    for dt in (None, "bfloat16"):
+        conf = _lenet_conf()
+        conf.global_conf.compute_dtype = dt
+        net = MultiLayerNetwork(conf).init()
+        sec, flops = _time_fit_scan(net, x, y, k=256)
+        ips = batch / sec
+        tag = "bf16" if dt else "f32"
+        out = _emit(
+            f"LeNet-MNIST train (batch={batch}, 1 chip, fit_scan, {tag})",
+            ips, "imgs/sec", BARS["lenet"],
+            {"mfu": _mfu(flops, 1.0 / sec), "compute_dtype": tag,
+             "data_source": data_source("mnist")})
+    return out
 
 
 def bench_resnet50():
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.resnet import ResNet50
-    from deeplearning4j_tpu.data.fetchers import load_cifar10
+    from deeplearning4j_tpu.data.fetchers import load_cifar10, data_source
 
     out = None
     for batch, k in ((128, 64), (512, 16)):
-        cg = ResNet50(num_classes=10, input_shape=(32, 32, 3), seed=7).init()
         x_all, y_all = load_cifar10(train=True, num_examples=batch)
         x, y = jnp.asarray(x_all), jnp.asarray(y_all)
-        sec, flops = _time_fit_scan(cg, x, y, k=k)
-        ips = batch / sec
-        out = _emit(
-            f"ResNet50-CIFAR10 train (batch={batch}, 1 chip, fit_scan)",
-            ips, "imgs/sec", BARS["resnet50"],
-            {"mfu": _mfu(flops, 1.0 / sec)})
+        for dt in (None, "bfloat16"):
+            cg = ResNet50(num_classes=10, input_shape=(32, 32, 3), seed=7,
+                          compute_dtype=dt).init()
+            sec, flops = _time_fit_scan(cg, x, y, k=k)
+            ips = batch / sec
+            tag = "bf16" if dt else "f32"
+            out = _emit(
+                f"ResNet50-CIFAR10 train (batch={batch}, 1 chip, fit_scan, "
+                f"{tag})", ips, "imgs/sec", BARS["resnet50"],
+                {"mfu": _mfu(flops, 1.0 / sec), "compute_dtype": tag,
+                 "data_source": data_source("cifar10")})
     return out
 
 
 def bench_vgg16(batch=128):
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.simple import VGG16
-    from deeplearning4j_tpu.data.fetchers import load_cifar10
+    from deeplearning4j_tpu.data.fetchers import load_cifar10, data_source
 
-    net = VGG16(num_classes=10, input_shape=(32, 32, 3), seed=7).init()
     x_all, y_all = load_cifar10(train=True, num_examples=batch)
     x, y = jnp.asarray(x_all), jnp.asarray(y_all)
-    sec, flops = _time_fit_scan(net, x, y, k=64)
-    ips = batch / sec
-    return _emit(f"VGG16-CIFAR10 train (batch={batch}, 1 chip, fit_scan)",
-                 ips, "imgs/sec", BARS["vgg16"],
-                 {"mfu": _mfu(flops, 1.0 / sec)})
+    out = None
+    for dt in (None, "bfloat16"):
+        net = VGG16(num_classes=10, input_shape=(32, 32, 3), seed=7,
+                    compute_dtype=dt).init()
+        sec, flops = _time_fit_scan(net, x, y, k=64)
+        ips = batch / sec
+        tag = "bf16" if dt else "f32"
+        out = _emit(
+            f"VGG16-CIFAR10 train (batch={batch}, 1 chip, fit_scan, {tag})",
+            ips, "imgs/sec", BARS["vgg16"],
+            {"mfu": _mfu(flops, 1.0 / sec), "compute_dtype": tag,
+             "data_source": data_source("cifar10")})
+    return out
 
 
 def bench_charrnn(batch=32, seq_len=64, vocab=77):

@@ -34,6 +34,16 @@ def data_dir() -> Path:
                                str(Path.home() / ".deeplearning4j_tpu")))
 
 
+# Provenance of the last load per dataset name: "real" (parsed from files in
+# the cache dir) or "synthetic" (deterministic generated fallback). Bench
+# rows record this so throughput numbers state what data they ran on.
+_SOURCES: dict = {}
+
+
+def data_source(name: str) -> str:
+    return _SOURCES.get(name, "unknown")
+
+
 def _one_hot(y, n):
     out = np.zeros((y.shape[0], n), np.float32)
     out[np.arange(y.shape[0]), y] = 1.0
@@ -85,9 +95,11 @@ def load_mnist(train=True, num_examples=None, flatten=True, seed=123):
         x = _read_idx_images(img_p).astype(np.float32) / 255.0
         x = x[..., None]
         y = _read_idx_labels(lab_p).astype(np.int64)
+        _SOURCES["mnist"] = "real"
     else:
         n = 60000 if train else 10000
         x, y = _synthetic_images(n, 28, 28, 1, 10, seed if train else seed + 1)
+        _SOURCES["mnist"] = "synthetic"
     if num_examples is not None:
         x, y = x[:num_examples], y[:num_examples]
     if flatten:
@@ -150,11 +162,13 @@ def load_cifar10(train=True, num_examples=None, seed=123):
             xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
         x = np.concatenate(xs).astype(np.float32) / 255.0
         y = np.concatenate(ys)
+        _SOURCES["cifar10"] = "real"
     else:
         n = 50000 if train else 10000
         if num_examples is not None:
             n = min(n, num_examples)
         x, y = _synthetic_images(n, 32, 32, 3, 10, seed if train else seed + 1)
+        _SOURCES["cifar10"] = "synthetic"
     if num_examples is not None:
         x, y = x[:num_examples], y[:num_examples]
     return x, _one_hot(y, 10)

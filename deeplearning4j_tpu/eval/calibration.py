@@ -122,7 +122,9 @@ class EvaluationCalibration:
 
         ex_w = (w.max(axis=1) > 0)           # rows with any live output
         self.label_counts += (l * w).sum(axis=0)
-        pred_cls = p.argmax(axis=1)
+        # masked-out columns must not win the argmax for a row's predicted
+        # class: exclude them (rows with no live column are dropped by ex_w)
+        pred_cls = np.where(w > 0, p, -np.inf).argmax(axis=1)
         np.add.at(self.prediction_counts, pred_cls[ex_w], 1)
 
         # residuals |l - p| and probability histograms over [0, 1]
@@ -136,7 +138,10 @@ class EvaluationCalibration:
         # per-label-class: rows whose label is class c contribute their
         # residual/probability for class c
         lab_cls = l.argmax(axis=1)
-        labeled = (l.max(axis=1) > 0) & ex_w
+        # a row only contributes per-class stats when its true-label column
+        # is itself live under the per-output mask
+        lab_live = np.take_along_axis(w, lab_cls[:, None], axis=1)[:, 0] > 0
+        labeled = (l.max(axis=1) > 0) & ex_w & lab_live
         cls = lab_cls[labeled]
         np.add.at(self.residual_by_class,
                   (rbins[labeled, cls], cls), 1)

@@ -112,7 +112,14 @@ class ComputationGraph:
                 continue
             ins = [acts[i] for i in node.inputs]
             if node.kind == "vertex":
-                acts[name] = node.vertex.apply(ins)
+                v = node.vertex
+                if getattr(v, "mask_input", None) is not None:
+                    # mask-aware vertex (LastTimeStepVertex): the named
+                    # network input's (B, T) mask locates true last steps
+                    m = masks.get(v.mask_input) if masks else None
+                    acts[name] = v.apply(ins, mask=m)
+                else:
+                    acts[name] = v.apply(ins)
                 continue
             lrng = None if rng is None else jax.random.fold_in(rng, idx)
             mask = None
@@ -430,9 +437,14 @@ class ComputationGraph:
         epsilons = [jnp.asarray(e) for e in epsilons] \
             if isinstance(epsilons, (list, tuple)) else [jnp.asarray(epsilons)]
 
+        # iteration-seeded PRNG like fit(): dropout/weight-noise behave the
+        # same on the external-epsilon path as in ordinary training
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.conf.global_conf.seed), self.iteration)
+
         def outs(params):
             acts, new_state, _ = self._forward(params, self.state, inputs,
-                                               train=True, rng=None)
+                                               train=True, rng=rng)
             return [acts[n] for n in self.conf.network_outputs], new_state
 
         _, vjp, new_state = jax.vjp(outs, self.params, has_aux=True)

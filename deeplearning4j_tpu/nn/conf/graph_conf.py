@@ -186,6 +186,112 @@ class ReshapeVertex(GraphVertex):
 
 @register_vertex
 @dataclass
+class LastTimeStepVertex(GraphVertex):
+    """(B, T, C) → (B, C): the last time step of a sequence, mask-aware.
+
+    Parity: nn/conf/graph/rnn/LastTimeStepVertex.java — the encoder half of
+    the CG seq2seq pattern (GravesLSTM → LastTimeStepVertex →
+    DuplicateToTimeSeriesVertex → decoder). ``mask_input`` names the network
+    input whose (B, T) mask locates each example's true last step; without a
+    mask the final step is taken."""
+    mask_input: Optional[str] = None
+
+    def apply(self, inputs, mask=None):
+        x = inputs[0]
+        if mask is None:
+            return x[:, -1, :]
+        idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+
+@register_vertex
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """(B, C) → (B, T, C): broadcast a vector across time, T taken from the
+    reference sequence named by ``ref_input`` (parity:
+    nn/conf/graph/rnn/DuplicateToTimeSeriesVertex.java — the decoder-seeding
+    half of the CG seq2seq pattern). ``ref_input`` is appended to the
+    vertex's graph inputs at add time, so topo order and serde carry it."""
+    ref_input: Optional[str] = None
+
+    def apply(self, inputs):
+        x, ref = inputs[0], inputs[-1]
+        return jnp.broadcast_to(x[:, None, :],
+                                (x.shape[0], ref.shape[1], x.shape[1]))
+
+    def output_type(self, input_types):
+        t0, tref = input_types[0], input_types[-1]
+        return InputType.recurrent(t0.flat_size(),
+                                   getattr(tref, "timeseries_length", None))
+
+
+@register_vertex
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two activations → (B, 1)
+    (parity: nn/conf/graph/L2Vertex.java)."""
+    eps: float = 1e-8
+
+    def apply(self, inputs):
+        d = inputs[0] - inputs[1]
+        ss = (d * d).sum(axis=tuple(range(1, d.ndim)))
+        return jnp.sqrt(jnp.maximum(ss, self.eps))[:, None]
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+
+@register_vertex
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Shape-transform vertex (parity: nn/conf/graph/PreprocessorVertex.java
+    wrapping the InputPreProcessor impls). Named transforms over this build's
+    native layouts (NHWC images, (B, T, C) sequences):
+
+    - ``cnn_to_ff``: (B, H, W, C) → (B, H·W·C)
+    - ``ff_to_cnn``: (B, H·W·C) → (B, height, width, channels) [fields]
+    - ``rnn_to_ff``: (B, T, C) → (B·T, C)
+    - ``ff_to_rnn``: (B·T, C) → (B, tsteps, C) [field]
+    """
+    preprocessor: str = "cnn_to_ff"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    tsteps: int = 0
+
+    def apply(self, inputs):
+        x = inputs[0]
+        if self.preprocessor == "cnn_to_ff":
+            return x.reshape(x.shape[0], -1)
+        if self.preprocessor == "ff_to_cnn":
+            return x.reshape(x.shape[0], self.height, self.width,
+                             self.channels)
+        if self.preprocessor == "rnn_to_ff":
+            return x.reshape(x.shape[0] * x.shape[1], x.shape[2])
+        if self.preprocessor == "ff_to_rnn":
+            return x.reshape(x.shape[0] // self.tsteps, self.tsteps,
+                             x.shape[1])
+        raise ValueError(f"Unknown preprocessor '{self.preprocessor}'")
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        if self.preprocessor == "cnn_to_ff":
+            return InputType.feed_forward(t.flat_size())
+        if self.preprocessor == "ff_to_cnn":
+            return InputType.convolutional(self.height, self.width,
+                                           self.channels)
+        if self.preprocessor == "rnn_to_ff":
+            return InputType.feed_forward(t.size)
+        if self.preprocessor == "ff_to_rnn":
+            return InputType.recurrent(t.flat_size(), self.tsteps)
+        raise ValueError(f"Unknown preprocessor '{self.preprocessor}'")
+
+
+@register_vertex
+@dataclass
 class PoolHelperVertex(GraphVertex):
     """Crops first row/col (parity: zoo GoogLeNet's PoolHelperVertex)."""
 
@@ -335,8 +441,14 @@ class GraphBuilder:
         return self
 
     def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str):
+        inputs = list(inputs)
+        ref = getattr(vertex, "ref_input", None)
+        if ref and ref not in inputs:
+            # DuplicateToTimeSeriesVertex's reference sequence is a real data
+            # dependency: wire it so topo sort orders it and apply() sees it
+            inputs.append(ref)
         self._conf.nodes[name] = _Node(name=name, kind="vertex", vertex=vertex,
-                                       inputs=list(inputs))
+                                       inputs=inputs)
         return self
 
     def set_outputs(self, *names):

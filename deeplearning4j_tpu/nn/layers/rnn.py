@@ -126,8 +126,11 @@ class LSTM(Layer):
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
         B = x.shape[0]
-        h0 = jnp.zeros((B, self.n_out), x.dtype)
-        c0 = jnp.zeros((B, self.n_out), x.dtype)
+        # carry dtype must match the promoted gate dtype (x64 gradient checks
+        # feed f64 params with f32 activations), or the scan carry mismatches
+        dt = jnp.result_type(x.dtype, params["W"].dtype)
+        h0 = jnp.zeros((B, self.n_out), dt)
+        c0 = jnp.zeros((B, self.n_out), dt)
         y, _ = self._scan(params, x, mask, h0, c0)
         return y, state
 
@@ -136,8 +139,9 @@ class LSTM(Layer):
         MultiLayerNetwork.java:2209 rnnActivateUsingStoredState)."""
         B = x.shape[0]
         if carry is None:
-            carry = (jnp.zeros((B, self.n_out), x.dtype),
-                     jnp.zeros((B, self.n_out), x.dtype))
+            dt = jnp.result_type(x.dtype, params["W"].dtype)
+            carry = (jnp.zeros((B, self.n_out), dt),
+                     jnp.zeros((B, self.n_out), dt))
         y, new_carry = self._scan(params, x, mask, carry[0], carry[1])
         return y, new_carry
 
@@ -215,7 +219,8 @@ class SimpleRnn(Layer):
             return h_new, h_new
 
         xs = gate_in if mask is None else (gate_in, mask_t)
-        h0 = jnp.zeros((B, self.n_out), x.dtype)
+        h0 = jnp.zeros((B, self.n_out),
+                       jnp.result_type(x.dtype, params["W"].dtype))
         _, hs = lax.scan(step, h0, xs)
         return hs.transpose(1, 0, 2), state
 

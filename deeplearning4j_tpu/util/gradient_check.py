@@ -53,6 +53,15 @@ def _gradient_check_fn_x64(loss_fn, params, eps, max_rel_error,
         lambda a: (jnp.asarray(a, jnp.float64)
                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
                    else jnp.asarray(a)), params)
+    for leaf in jax.tree_util.tree_leaves(params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating) and \
+                leaf.dtype != jnp.float64:
+            # x64 must actually be enabled here or the whole check silently
+            # runs at f32 against its own design (parity:
+            # GradientCheckUtil.java:57 forces DOUBLE)
+            raise RuntimeError(
+                f"gradient check requires f64 but got {leaf.dtype}; "
+                "is jax.enable_x64 active?")
     loss_fn = jax.jit(loss_fn)  # compile once; FD loop then runs fast
     grads = jax.jit(jax.grad(loss_fn))(params)
     leaves, treedef = jax.tree_util.tree_flatten(params)

@@ -36,8 +36,15 @@ class ZooModel:
         return self.kwargs.get("updater") or default
 
     def init(self):
-        """Build + initialize the network (parity: ZooModel.init)."""
+        """Build + initialize the network (parity: ZooModel.init).
+
+        ``compute_dtype='bfloat16'`` constructor kwarg enables mixed-precision
+        compute on any zoo model: params stay f32, forward/backward cast to
+        the compute dtype (MXU-friendly; see util/dtypes.py contract)."""
         conf = self.conf()
+        cd = self.kwargs.get("compute_dtype")
+        if cd:
+            conf.global_conf.compute_dtype = cd
         from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
         from deeplearning4j_tpu.models import MultiLayerNetwork, ComputationGraph
         if isinstance(conf, MultiLayerConfiguration):

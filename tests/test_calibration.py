@@ -135,3 +135,27 @@ class TestMergeAndECE:
         ec.eval(labels, preds)
         assert abs(ec.expected_calibration_error()) < 1e-12
         assert "ECE" in ec.stats()
+
+
+def test_masked_column_cannot_win_argmax():
+    """A masked-out class column must not be counted as the predicted class
+    even when its raw probability is the max (per-output mask)."""
+    ec = EvaluationCalibration()
+    labels = np.array([[0, 1, 0]], np.float32)
+    preds = np.array([[0.1, 0.3, 0.6]], np.float32)   # class 2 wins raw argmax
+    mask = np.array([[1, 1, 0]], np.float32)          # ...but is masked out
+    ec.eval(labels, preds, mask=mask)
+    assert ec.prediction_counts[2] == 0
+    assert ec.prediction_counts[1] == 1
+
+
+def test_masked_label_column_excluded_from_per_class_stats():
+    """Rows whose true-label column is masked out must not contribute to
+    that class's residual/probability histograms."""
+    ec = EvaluationCalibration()
+    labels = np.array([[0, 1, 0]], np.float32)
+    preds = np.array([[0.1, 0.3, 0.6]], np.float32)
+    mask = np.array([[1, 0, 1]], np.float32)          # true class 1 masked
+    ec.eval(labels, preds, mask=mask)
+    assert ec.residual_by_class[:, 1].sum() == 0
+    assert ec.prob_by_class[:, 1].sum() == 0

@@ -173,8 +173,9 @@ def test_masking_gradients():
             .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
             .set_input_type(InputType.recurrent(4)).build())).init(jax.random.PRNGKey(3))
     import jax.numpy as jnp
-    params64 = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64),
-                                      net.params)
+    # NOTE: no f64 cast here — outside the checker's scoped x64 it would
+    # silently truncate to f32 (with a warning); gradient_check_fn upcasts
+    # float leaves inside the x64 scope and asserts they really are f64
 
     def loss_fn(params):
         loss, _ = net._loss(params, net.state, jnp.asarray(x), jnp.asarray(y),
@@ -182,7 +183,7 @@ def test_masking_gradients():
         return loss
 
     from deeplearning4j_tpu.util.gradient_check import gradient_check_fn
-    fails, checked, worst = gradient_check_fn(loss_fn, params64,
+    fails, checked, worst = gradient_check_fn(loss_fn, net.params,
                                               max_checks_per_array=10)
     assert fails == 0, f"{fails}/{checked} failed (worst {worst:.2e})"
 
@@ -235,3 +236,30 @@ def test_embedding_gradients():
             .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
             .set_input_type(InputType.feed_forward(7)).build())
     _check(conf, x, y)
+
+
+def test_rnn_gradient_check_f32_inputs():
+    """gradient_check_fn upcasts params to f64 internally while the closure
+    feeds f32 activations — recurrent scan carries must follow the promoted
+    dtype instead of x.dtype (regression: carry type mismatch crash)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.util.gradient_check import gradient_check_fn
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 5, 3).astype(np.float32)          # f32 on purpose
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (2, 5))]
+    for layer in (SimpleRnn(n_out=5), LSTM(n_out=5)):
+        net = MultiLayerNetwork((_builder().list()
+                .layer(layer)
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(3)).build())).init()
+
+        def loss_fn(params):
+            loss, _ = net._loss(params, net.state, jnp.asarray(x),
+                                jnp.asarray(y), None, None, None)
+            return loss
+
+        fails, checked, _ = gradient_check_fn(loss_fn, net.params,
+                                              max_checks_per_array=6)
+        assert fails == 0 and checked > 0
