@@ -30,11 +30,12 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers import (
-    ActivationLayer, BatchNormalization, ConvolutionLayer, Cropping2D,
-    DenseLayer, DepthwiseConvolution2D, DropoutLayer, EmbeddingSequenceLayer,
-    GlobalPoolingLayer, LastTimeStep, LSTM, OutputLayer,
-    SeparableConvolution2D, SimpleRnn, SubsamplingLayer, Upsampling2D,
-    ZeroPaddingLayer,
+    ActivationLayer, BatchNormalization, Convolution1DLayer, ConvolutionLayer,
+    Cropping2D, DenseLayer, DepthwiseConvolution2D, DropoutLayer,
+    EmbeddingSequenceLayer, FlattenLayer, GlobalPoolingLayer, LastTimeStep,
+    LocalResponseNormalization, LSTM, OutputLayer, ReshapeLayer,
+    SeparableConvolution2D, SimpleRnn, Subsampling1DLayer, SubsamplingLayer,
+    Upsampling1D, Upsampling2D, ZeroPadding1DLayer, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.conf.graph_conf import (ElementWiseVertex,
                                                    MergeVertex)
@@ -134,6 +135,12 @@ def _pair(v) -> Tuple[int, int]:
     return (int(v), int(v))
 
 
+def _single(v) -> int:
+    """Scalar-or-singleton-list 1-D hyperparameter (Keras stores Conv1D
+    kernel_size as [k])."""
+    return int(v[0] if isinstance(v, (list, tuple)) else v)
+
+
 # ---------------------------------------------------------------------------
 # per-layer translators (parity: keras/layers/** KerasDense, KerasConvolution…)
 # ---------------------------------------------------------------------------
@@ -167,20 +174,60 @@ def _translate_layer(class_name: str, cfg: Dict, keras_major: int):
         return DropoutLayer(dropout=float(cfg.get("rate", cfg.get("p", 0.0))))
     if class_name == "Flatten":
         return "flatten"
-    if class_name in ("Reshape", "Permute", "RepeatVector", "Masking"):
+    if class_name == "Reshape":
+        return ReshapeLayer(target_shape=tuple(cfg.get("target_shape", ())))
+    if class_name in ("Permute", "RepeatVector", "Masking"):
         raise UnsupportedKerasConfigurationException(
             f"Keras layer '{class_name}' is not yet supported")
-    if class_name in ("Conv2D", "Convolution2D"):
+    if class_name in ("Conv2D", "Convolution2D", "AtrousConvolution2D"):
+        # AtrousConvolution2D (Keras 1) is a dilated conv: atrous_rate maps
+        # to dilation (parity: KerasAtrousConvolution2D.java)
         k = (_pair(cfg["kernel_size"]) if "kernel_size" in cfg
              else _keras1_kernel(cfg))
+        dil = _pair(cfg.get("dilation_rate", cfg.get("atrous_rate", (1, 1))))
         return ConvolutionLayer(
             n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
             kernel_size=k,
             stride=_pair(cfg.get("strides", cfg.get("subsample", (1, 1)))),
-            dilation=_pair(cfg.get("dilation_rate", (1, 1))),
+            dilation=dil,
             convolution_mode=_conv_mode(cfg),
             activation=act or "identity",
             has_bias=bool(cfg.get("use_bias", cfg.get("bias", True))))
+    if class_name in ("Conv1D", "Convolution1D", "AtrousConvolution1D"):
+        border = cfg.get("padding", cfg.get("border_mode", "valid"))
+        if border == "causal":
+            raise UnsupportedKerasConfigurationException(
+                "Keras Conv1D causal padding is not supported")
+        return Convolution1DLayer(
+            n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+            kernel_size=_single(cfg.get("kernel_size",
+                                        cfg.get("filter_length", 3))),
+            stride=_single(cfg.get("strides", cfg.get("subsample_length", 1))),
+            dilation=_single(cfg.get("dilation_rate",
+                                     cfg.get("atrous_rate", 1))),
+            convolution_mode=_conv_mode(cfg),
+            activation=act or "identity",
+            has_bias=bool(cfg.get("use_bias", cfg.get("bias", True))))
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        p = _single(cfg.get("pool_size", cfg.get("pool_length", 2)))
+        s = _single(cfg.get("strides", cfg.get("stride")) or p)
+        return Subsampling1DLayer(
+            pooling_type="max" if class_name.startswith("Max") else "avg",
+            kernel_size=p, stride=s, convolution_mode=_conv_mode(cfg),
+            avg_count_includes_padding=False)   # Keras/TF edge semantics
+    if class_name == "UpSampling1D":
+        return Upsampling1D(size=_single(cfg.get("size",
+                                                 cfg.get("length", 2))))
+    if class_name == "ZeroPadding1D":
+        p = cfg.get("padding", 1)
+        pad = ((int(p[0]), int(p[1])) if isinstance(p, (list, tuple))
+               else (int(p), int(p)))
+        return ZeroPadding1DLayer(padding=pad)
+    if class_name in ("LRN", "LRN2D", "LocalResponseNormalization"):
+        # Keras-contrib / Keras 0.x LRN (parity: KerasLRN.java)
+        return LocalResponseNormalization(
+            k=float(cfg.get("k", 2.0)), alpha=float(cfg.get("alpha", 1e-4)),
+            beta=float(cfg.get("beta", 0.75)), n=int(cfg.get("n", 5)))
     if class_name == "SeparableConv2D":
         return SeparableConvolution2D(
             n_out=int(cfg.get("filters", 0)),
@@ -203,7 +250,8 @@ def _translate_layer(class_name: str, cfg: Dict, keras_major: int):
             pooling_type="max" if class_name.startswith("Max") else "avg",
             kernel_size=_pair(cfg.get("pool_size", (2, 2))),
             stride=_pair(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
-            convolution_mode=_conv_mode(cfg))
+            convolution_mode=_conv_mode(cfg),
+            avg_count_includes_padding=False)   # Keras/TF edge semantics
     if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
                       "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
         return GlobalPoolingLayer(
@@ -259,6 +307,18 @@ def _translate_layer(class_name: str, cfg: Dict, keras_major: int):
         f"Unsupported Keras layer type '{class_name}'")
 
 
+def _check_reshape(t, channels_first: bool):
+    """A 3-D Reshape target in a channels_first model means (C, H, W) over
+    NCHW data; our NHWC runtime cannot honor it with a plain reshape —
+    refuse loudly instead of producing silently scrambled activations."""
+    if isinstance(t, ReshapeLayer) and channels_first \
+            and len(t.target_shape) == 3:
+        raise UnsupportedKerasConfigurationException(
+            "Reshape to a 3-D target in a channels_first model is not "
+            "supported (NHWC runtime would scramble the layout)")
+    return t
+
+
 def _input_type_from_shape(shape, data_format: str) -> InputType:
     """batch_input_shape (excluding batch dim) → InputType. Rank decides the
     kind; ``None`` dims stay as wildcards (variable timesteps / image size),
@@ -278,7 +338,8 @@ def _input_type_from_shape(shape, data_format: str) -> InputType:
         if dims[1] is None:
             raise UnsupportedKerasConfigurationException(
                 f"Recurrent input with unknown feature size: {shape}")
-        return InputType.recurrent(int(dims[1]))
+        return InputType.recurrent(int(dims[1]),
+                                   int(dims[0]) if dims[0] else -1)
     if len(dims) == 1:
         if dims[0] is None:
             raise UnsupportedKerasConfigurationException(
@@ -342,6 +403,13 @@ def _set_layer_weights(layer, params: Dict, weights: List[np.ndarray],
     elif isinstance(layer, DepthwiseConvolution2D):
         dk = weights[0]  # keras: (kh, kw, in, mult) — ours: (kh, kw, in, mult)
         put("dW", dk) if "dW" in params else put("W", dk)
+        if layer.has_bias and len(weights) > 1:
+            put("b", weights[1])
+    elif isinstance(layer, Convolution1DLayer):
+        k = weights[0]
+        if k.ndim == 4:          # keras1 stores (filter_length, 1, in, out)
+            k = k[:, 0, :, :]
+        put("W", k)
         if layer.has_bias and len(weights) > 1:
             put("b", weights[1])
     elif isinstance(layer, ConvolutionLayer) and not isinstance(
@@ -483,7 +551,14 @@ def import_keras_sequential_model_and_weights(
         theano_kernels = channels_first and backend != "tensorflow"
 
         if input_type is None:
-            shape = entries[0][1].get("batch_input_shape")
+            # Keras 1/2: batch_input_shape on the first real layer;
+            # Keras 3 legacy h5: batch_shape on an explicit InputLayer
+            shape = None
+            for _, lcfg, _ in entries[:2]:
+                shape = (lcfg.get("batch_input_shape")
+                         or lcfg.get("batch_shape"))
+                if shape is not None:
+                    break
             if shape is None:
                 raise InvalidKerasConfigurationException(
                     "First layer has no batch_input_shape; pass input_type=")
@@ -494,8 +569,12 @@ def import_keras_sequential_model_and_weights(
         flatten_pending = False
         flatten_after: Dict[int, bool] = {}
         for class_name, lcfg, name in entries:
-            t = _translate_layer(class_name, lcfg, 2)
+            t = _check_reshape(_translate_layer(class_name, lcfg, 2),
+                               channels_first)
             if t == "flatten":
+                # a real layer: our Dense is time-distributed over (B, T, C)
+                # sequence inputs, so Keras's flatten must actually flatten
+                ours.append((FlattenLayer(), name))
                 flatten_pending = True
                 continue
             if t is None:
@@ -532,7 +611,7 @@ def import_keras_sequential_model_and_weights(
                 continue
             fp = None
             if channels_first and flatten_after.get(idx):
-                it = out_types[idx]
+                it = out_types[idx - 1]      # input of the FlattenLayer
                 if it.kind == "cnn":
                     fp = (it.height, it.width, it.channels)
             new_state = _set_layer_weights(net.layers[idx], net.params[idx], w,
@@ -581,16 +660,39 @@ def import_keras_model_and_weights(
         translated: Dict[str, Any] = {}
         flatten_nodes: set = set()          # names of Flatten pass-throughs
         node_inputs: Dict[str, List[str]] = {}
-        output_names = [o[0] for o in cfg["output_layers"]]
+
+        def _names(spec) -> List[str]:
+            # Keras 2: [["name", 0, 0], ...]; Keras 3 single output:
+            # ["name", 0, 0]
+            if spec and isinstance(spec[0], str):
+                return [spec[0]]
+            return [o[0] for o in spec]
+
+        output_names = _names(cfg["output_layers"])
 
         def inbound(ld) -> List[str]:
             nodes = ld.get("inbound_nodes", [])
             if not nodes:
                 return []
             first = nodes[0]
-            if isinstance(first, dict):     # keras 3 style {args: ...}
-                raise UnsupportedKerasConfigurationException(
-                    "Keras 3 inbound_nodes format not supported")
+            if isinstance(first, dict):
+                # Keras 3: {"args": [KerasTensor | [KerasTensor...]], ...};
+                # source layer names live in each tensor's keras_history
+                names: List[str] = []
+
+                def walk(o):
+                    if isinstance(o, dict):
+                        if o.get("class_name") == "__keras_tensor__":
+                            names.append(o["config"]["keras_history"][0])
+                        else:
+                            for v in o.values():
+                                walk(v)
+                    elif isinstance(o, (list, tuple)):
+                        for v in o:
+                            walk(v)
+
+                walk(first.get("args", []))
+                return names
             return [n[0] for n in first]
 
         for ld in layers:
@@ -599,7 +701,8 @@ def import_keras_model_and_weights(
             ins = inbound(ld)
             if cls == "InputLayer":
                 input_names.append(name)
-                shape = lcfg.get("batch_input_shape")
+                shape = (lcfg.get("batch_input_shape")
+                         or lcfg.get("batch_shape"))   # keras 3
                 if shape is not None:
                     in_types.append(_input_type_from_shape(shape[1:],
                                                            data_format))
@@ -626,11 +729,10 @@ def import_keras_model_and_weights(
             if cls == "Concatenate":
                 gb.add_vertex(name, MergeVertex(), *ins)
                 continue
-            t = _translate_layer(cls, lcfg, 2)
+            t = _check_reshape(_translate_layer(cls, lcfg, 2), channels_first)
             if t == "flatten":
-                # our dense layers flatten cnn input natively; pass through
                 flatten_nodes.add(name)
-                gb.add_vertex(name, ElementWiseVertex(op="add"), *ins)
+                gb.add_layer(name, FlattenLayer(), *ins)
                 continue
             if loss_name is not None and name in output_names \
                     and isinstance(t, DenseLayer) \

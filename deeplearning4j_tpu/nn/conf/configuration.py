@@ -45,7 +45,7 @@ class GlobalConf:
     updater: Updater = dc_field(default_factory=lambda: Sgd(1e-3))
     l1: float = 0.0
     l2: float = 0.0
-    dropout: float = 0.0
+    dropout: object = 0.0                # float drop-prob or IDropout object
     optimization_algo: str = "sgd"       # sgd | lbfgs | line_gradient_descent
     max_num_line_search_iterations: int = 5
     minimize: bool = True
@@ -63,12 +63,18 @@ class GlobalConf:
                 "dropout": self.dropout, "weight_noise": self.weight_noise}
 
     def to_dict(self):
+        from deeplearning4j_tpu.nn.dropout import IDropout
         wn = self.weight_noise
-        self_no_wn = dataclasses.replace(self, weight_noise=None)
-        d = dataclasses.asdict(self_no_wn)
+        do = self.dropout
+        plain = dataclasses.replace(
+            self, weight_noise=None,
+            dropout=0.0 if isinstance(do, IDropout) else do)
+        d = dataclasses.asdict(plain)
         d["updater"] = self.updater.to_dict()
         if wn is not None:
             d["weight_noise"] = wn.to_dict()
+        if isinstance(do, IDropout):
+            d["dropout"] = do.to_dict()
         return d
 
     @staticmethod
@@ -80,6 +86,9 @@ class GlobalConf:
         if d.get("weight_noise") is not None:
             from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
             d["weight_noise"] = IWeightNoise.from_dict(d["weight_noise"])
+        if isinstance(d.get("dropout"), dict):
+            from deeplearning4j_tpu.nn.dropout import IDropout
+            d["dropout"] = IDropout.from_dict(d["dropout"])
         return GlobalConf(**d)
 
 
@@ -128,7 +137,11 @@ class Builder:
         self._g.l2 = float(v); return self
 
     def dropout(self, v):
-        self._g.dropout = float(v); return self
+        """Float drop-probability or an IDropout object
+        (Dropout/AlphaDropout/GaussianDropout/GaussianNoise)."""
+        from deeplearning4j_tpu.nn.dropout import IDropout
+        self._g.dropout = v if isinstance(v, IDropout) else float(v)
+        return self
 
     def optimization_algo(self, a):
         self._g.optimization_algo = str(a).lower(); return self

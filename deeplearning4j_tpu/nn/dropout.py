@@ -1,0 +1,106 @@
+"""Dropout family — standard, alpha, gaussian-multiplicative, gaussian-add.
+
+Parity surface: reference nn/conf/dropout/ — IDropout.java (applyDropout on
+input activations at forward time), Dropout.java, AlphaDropout.java
+(SELU-self-normalization-preserving, Klambauer et al. 2017 §A),
+GaussianDropout.java (multiplicative N(1, rate/(1-rate)) noise) and
+GaussianNoise.java (additive N(0, stddev)). A layer's ``dropout`` field
+takes either a plain float drop-probability (standard dropout, the common
+case) or one of these objects; the containers draw a fresh fold of the
+iteration-seeded PRNG per layer per step, so noise is i.i.d. across steps
+but reproducible given the seed.
+
+NOTE: this build uses DROP probability p everywhere (keep = 1-p), unlike
+dl4j's retain-probability convention — documented on Layer.dropout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_DROPOUT_REGISTRY = {}
+
+
+def _register(cls):
+    _DROPOUT_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class IDropout:
+    """Base: apply(x, rng) -> noised activations (train-time only; the
+    containers skip the call at inference, matching the reference's
+    inverted-dropout convention of no test-time rescaling)."""
+
+    def apply(self, x, rng):
+        raise NotImplementedError
+
+    def to_dict(self):
+        return {"@dropout": type(self).__name__, **dataclasses.asdict(self)}
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _DROPOUT_REGISTRY[d.pop("@dropout")]
+        return cls(**d)
+
+
+@_register
+@dataclass
+class Dropout(IDropout):
+    """Standard inverted dropout (parity: nn/conf/dropout/Dropout.java)."""
+    p: float = 0.5
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.p
+        m = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(m, x / keep, jnp.zeros((), x.dtype))
+
+
+@_register
+@dataclass
+class AlphaDropout(IDropout):
+    """Dropout that preserves the self-normalizing property of SELU nets
+    (parity: nn/conf/dropout/AlphaDropout.java): dropped units are set to
+    alpha' = -scale*alpha and the result is affine-corrected so mean/variance
+    are unchanged."""
+    p: float = 0.05
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.p
+        ap = -self._SCALE * self._ALPHA                      # alpha'
+        a = (keep + ap * ap * keep * (1.0 - keep)) ** -0.5
+        b = -a * ap * (1.0 - keep)
+        m = jax.random.bernoulli(rng, keep, x.shape)
+        return (a * jnp.where(m, x, jnp.asarray(ap, x.dtype)) + b).astype(
+            x.dtype)
+
+
+@_register
+@dataclass
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise ~ N(1, rate/(1-rate))
+    (parity: nn/conf/dropout/GaussianDropout.java)."""
+    rate: float = 0.5
+
+    def apply(self, x, rng):
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype))
+
+
+@_register
+@dataclass
+class GaussianNoise(IDropout):
+    """Additive gaussian noise N(0, stddev)
+    (parity: nn/conf/dropout/GaussianNoise.java)."""
+    stddev: float = 0.1
+
+    def apply(self, x, rng):
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)

@@ -9,7 +9,8 @@ pure function, and the backward pass is ``jax.grad`` of the container loss.
 from deeplearning4j_tpu.nn.layers.base import Layer, LAYER_REGISTRY, layer_from_dict
 from deeplearning4j_tpu.nn.layers.core import (
     DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
-    EmbeddingLayer, EmbeddingSequenceLayer, PReLULayer, ElementWiseMultiplicationLayer,
+    EmbeddingLayer, EmbeddingSequenceLayer, PReLULayer,
+    ElementWiseMultiplicationLayer, ReshapeLayer, FlattenLayer,
 )
 from deeplearning4j_tpu.nn.layers.conv import (
     ConvolutionLayer, Convolution1DLayer, SeparableConvolution2D,
@@ -35,7 +36,7 @@ __all__ = [
     "Layer", "LAYER_REGISTRY", "layer_from_dict",
     "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer", "DropoutLayer",
     "EmbeddingLayer", "EmbeddingSequenceLayer", "PReLULayer",
-    "ElementWiseMultiplicationLayer",
+    "ElementWiseMultiplicationLayer", "ReshapeLayer", "FlattenLayer",
     "ConvolutionLayer", "Convolution1DLayer", "SeparableConvolution2D",
     "DepthwiseConvolution2D", "Deconvolution2D", "SubsamplingLayer",
     "Subsampling1DLayer", "Upsampling1D", "Upsampling2D", "ZeroPaddingLayer",

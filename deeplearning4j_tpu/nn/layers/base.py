@@ -94,12 +94,19 @@ class Layer:
         return True
 
     # dropout on the INPUT activations, matching the reference convention
-    # (BaseLayer.applyDropOutIfNecessary before preOutput)
+    # (BaseLayer.applyDropOutIfNecessary before preOutput). ``dropout`` is a
+    # float drop-probability (standard dropout) or an IDropout object
+    # (AlphaDropout/GaussianDropout/GaussianNoise — nn/conf/dropout parity)
     def maybe_dropout(self, x, *, train, rng):
-        p = self.dropout
-        if not train or p is None or p <= 0.0 or rng is None:
+        d = self.dropout
+        if not train or d is None or rng is None:
             return x
-        keep = 1.0 - p
+        from deeplearning4j_tpu.nn.dropout import IDropout
+        if isinstance(d, IDropout):
+            return d.apply(x, rng)
+        if d <= 0.0:
+            return x
+        keep = 1.0 - d
         m = jax.random.bernoulli(rng, keep, x.shape)
         return jnp.where(m, x / keep, 0.0)
 
@@ -147,12 +154,13 @@ class Layer:
     # ---- serde -----------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
+        from deeplearning4j_tpu.nn.dropout import IDropout
         d = {}
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
             if isinstance(v, Updater):
                 v = v.to_dict()
-            elif isinstance(v, IWeightNoise):
+            elif isinstance(v, (IWeightNoise, IDropout)):
                 v = v.to_dict()
             elif isinstance(v, Layer):  # wrappers (Bidirectional, Frozen)
                 v = v.to_dict()
@@ -176,6 +184,9 @@ class Layer:
             elif isinstance(v, dict) and "@noise" in v:
                 from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
                 v = IWeightNoise.from_dict(v)
+            elif isinstance(v, dict) and "@dropout" in v:
+                from deeplearning4j_tpu.nn.dropout import IDropout
+                v = IDropout.from_dict(v)
             elif isinstance(v, dict) and "@type" in v:
                 v = layer_from_dict(v)
             elif isinstance(v, list):

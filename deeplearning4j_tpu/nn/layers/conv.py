@@ -244,6 +244,10 @@ class SubsamplingLayer(Layer):
     padding: Tuple[int, int] = (0, 0)
     convolution_mode: str = "truncate"
     pnorm: int = 2
+    # avg divisor at padded edges: True = kernel size (reference/dl4j
+    # semantics), False = only real positions (Keras/TF semantics — set by
+    # the Keras importer so imported AveragePooling matches Keras output)
+    avg_count_includes_padding: bool = True
 
     def __post_init__(self):
         self.kernel_size = as_pair(self.kernel_size)
@@ -275,7 +279,13 @@ class SubsamplingLayer(Layer):
         elif self.pooling_type in ("avg", "sum"):
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
             if self.pooling_type == "avg":
-                y = y / (kh * kw)
+                if self.avg_count_includes_padding:
+                    y = y / (kh * kw)
+                else:
+                    ones = jnp.ones_like(x)
+                    cnt = lax.reduce_window(ones, 0.0, lax.add, dims,
+                                            strides, pad)
+                    y = y / cnt
         elif self.pooling_type == "pnorm":
             p = float(self.pnorm)
             y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad)
@@ -293,6 +303,7 @@ class Subsampling1DLayer(Layer):
     stride: int = 2
     padding: int = 0
     convolution_mode: str = "truncate"
+    avg_count_includes_padding: bool = True   # False = Keras/TF semantics
 
     def has_params(self):
         return False
@@ -313,7 +324,12 @@ class Subsampling1DLayer(Layer):
         else:
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
             if self.pooling_type == "avg":
-                y = y / self.kernel_size
+                if self.avg_count_includes_padding:
+                    y = y / self.kernel_size
+                else:
+                    cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                            dims, strides, pad)
+                    y = y / cnt
         return y, state
 
 

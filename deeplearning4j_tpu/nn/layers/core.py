@@ -121,6 +121,87 @@ class DropoutLayer(Layer):
 
 @register_layer
 @dataclass
+class FlattenLayer(Layer):
+    """Flatten all non-batch dims to (B, N). Needed for Keras-import parity
+    where a Flatten precedes a Dense over a SEQUENCE input — our DenseLayer
+    is time-distributed on (B, T, C), not flattening (for CNN inputs it
+    flattens natively, core.py:30)."""
+
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        if input_type.kind == "rnn":
+            t = input_type.timeseries_length
+            if t is None or t <= 0:
+                raise ValueError(
+                    "FlattenLayer over a sequence input needs a static "
+                    "timeseries length (flat width = size * T)")
+            return InputType.feed_forward(input_type.size * t)
+        return InputType.feed_forward(input_type.flat_size())
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@register_layer
+@dataclass
+class ReshapeLayer(Layer):
+    """Reshape activations to ``target_shape`` (excluding the batch dim).
+    Parity role: the reference's ReshapeVertex / KerasReshape
+    (modelimport/keras/layers/core/KerasReshape.java) as a sequential layer.
+    Rank decides the output kind: 1 → feed-forward, 2 → recurrent (T, C),
+    3 → convolutional (H, W, C) — this build's native layouts. One ``-1``
+    wildcard dim is resolved from the input's flat size (Keras Reshape
+    semantics)."""
+    target_shape: tuple = ()
+
+    def __post_init__(self):
+        self.target_shape = tuple(int(d) for d in self.target_shape)
+        if sum(1 for d in self.target_shape if d == -1) > 1:
+            raise ValueError(
+                f"Reshape target {self.target_shape} has more than one -1")
+
+    def has_params(self):
+        return False
+
+    def _resolved(self, flat: int) -> tuple:
+        s = self.target_shape
+        if -1 not in s:
+            return s
+        known = 1
+        for d in s:
+            if d != -1:
+                known *= d
+        if known <= 0 or flat % known != 0:
+            raise ValueError(
+                f"Cannot infer -1 in reshape target {s} from flat size {flat}")
+        return tuple(flat // known if d == -1 else d for d in s)
+
+    def output_type(self, input_type):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        s = self.target_shape
+        if -1 in s:
+            flat = (input_type.size * input_type.timeseries_length
+                    if input_type.kind == "rnn"
+                    and input_type.timeseries_length > 0
+                    else input_type.flat_size())
+            s = self._resolved(flat)
+        if len(s) == 1:
+            return InputType.feed_forward(s[0])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        raise ValueError(f"Unsupported reshape target {s}")
+
+    def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
+        return x.reshape((x.shape[0],) + self.target_shape), state
+
+
+@register_layer
+@dataclass
 class EmbeddingLayer(Layer):
     """Index → vector lookup (parity: nn/conf/layers/EmbeddingLayer.java).
     Input: (B,) or (B,1) int indices. A gather, not a one-hot matmul —

@@ -1,0 +1,119 @@
+"""Dropout family tests (parity role: nn/conf/dropout/ —
+TestDropout-style semantics + gradient checks + serde sweep).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.nn.dropout import (
+    IDropout, Dropout, AlphaDropout, GaussianDropout, GaussianNoise)
+
+ALL_KINDS = [Dropout(p=0.3), AlphaDropout(p=0.1), GaussianDropout(rate=0.4),
+             GaussianNoise(stddev=0.2)]
+
+
+def _net(dropout):
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh", dropout=dropout))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_inference_is_identity():
+    """No dropout noise at inference (inverted dropout, like the reference)."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 5).astype(np.float32)
+    ref = np.asarray(_net(None).output(x))
+    for d in ALL_KINDS:
+        net = _net(d)
+        # same seed → same params → identical inference output
+        np.testing.assert_allclose(np.asarray(net.output(x)), ref,
+                                   rtol=1e-6, err_msg=type(d).__name__)
+
+
+def test_statistical_semantics():
+    """Each kind's defining moment property, measured on a big sample."""
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((200, 500), jnp.float32) * 2.0
+
+    out = Dropout(p=0.3).apply(x, rng)
+    assert abs(float(out.mean()) - 2.0) < 0.02          # E preserved (inverted)
+    assert abs(float((out == 0).mean()) - 0.3) < 0.02   # ~p zeros
+
+    out = GaussianDropout(rate=0.4).apply(x, rng)
+    assert abs(float(out.mean()) - 2.0) < 0.02          # multiplicative N(1,·)
+    want_std = 2.0 * (0.4 / 0.6) ** 0.5
+    assert abs(float(out.std()) - want_std) < 0.05
+
+    out = GaussianNoise(stddev=0.2).apply(x, rng)
+    assert abs(float(out.mean()) - 2.0) < 0.01          # additive N(0, 0.2)
+    assert abs(float(out.std()) - 0.2) < 0.01
+
+    # AlphaDropout: preserves mean/variance of a standardized input
+    z = jax.random.normal(jax.random.PRNGKey(1), (200, 500))
+    out = AlphaDropout(p=0.1).apply(z, rng)
+    assert abs(float(out.mean())) < 0.02
+    assert abs(float(out.std()) - 1.0) < 0.03
+
+
+def test_gradient_check_each_kind():
+    """Fixed-rng gradient check through every dropout kind — the noise is
+    deterministic given the rng, so FD vs autodiff must agree."""
+    from deeplearning4j_tpu.util.gradient_check import gradient_check_fn
+
+    rs = np.random.RandomState(5)
+    x = rs.randn(4, 5)
+    y = np.eye(3)[rs.randint(0, 3, 4)]
+    for d in ALL_KINDS:
+        net = _net(d)
+        rng = jax.random.PRNGKey(7)
+
+        def loss_fn(params):
+            loss, _ = net._loss(params, net.state, jnp.asarray(x),
+                                jnp.asarray(y), rng, None, None)
+            return loss
+
+        fails, checked, worst = gradient_check_fn(loss_fn, net.params,
+                                                  max_checks_per_array=8)
+        assert fails == 0, f"{type(d).__name__}: {fails}/{checked} " \
+                           f"(worst {worst:.2e})"
+        assert checked > 0
+
+
+def test_serde_round_trip_layer_and_global():
+    """All four kinds survive JSON round-trip both as a layer field and as
+    the network-level default."""
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    for d in ALL_KINDS:
+        conf = (NeuralNetConfiguration.builder().seed(1).dropout(d)
+                .list()
+                .layer(DenseLayer(n_out=4, dropout=d))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.global_conf.dropout == d
+        assert conf2.layers[0].dropout == d
+        assert isinstance(conf2.layers[0].dropout, type(d))
+
+
+def test_training_with_dropout_learns():
+    """End-to-end: a net with each dropout kind still trains."""
+    rs = np.random.RandomState(2)
+    x = rs.rand(64, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(x.sum(axis=1) * 2).astype(int) % 3]
+    from deeplearning4j_tpu.data.dataset import DataSet
+    ds = DataSet(x, y)
+    for d in ALL_KINDS:
+        net = _net(d)
+        s0 = net.score(ds)
+        for _ in range(30):
+            net.fit(ds)
+        assert net.score(ds) < s0, type(d).__name__
