@@ -220,24 +220,30 @@ def bench_charrnn(batch=32, seq_len=64, vocab=77):
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
         np.roll(ids, -1, axis=1)])
 
-    def measure():
-        net = TextGenerationLSTM(total_unique_characters=vocab).init()
+    def measure(dt=None):
+        net = TextGenerationLSTM(total_unique_characters=vocab,
+                                 compute_dtype=dt).init()
         sec, flops = _time_fit_scan(net, x, y, k=64)
         return sec, flops
 
     ops.set_helpers_enabled(True)      # fused Pallas kernel
     sec_fused, flops = measure()
+    sec_bf16, flops_bf16 = measure("bfloat16")
     ops.set_helpers_enabled(False)     # pure lax.scan path
     sec_scan, _ = measure()
     ops.set_helpers_enabled(None)
 
+    _emit(
+        f"charRNN-LSTM train (batch={batch}, T={seq_len}, fused kernel, "
+        "bf16)", batch * seq_len / sec_bf16, "chars/sec", BARS["charrnn"],
+        {"mfu": _mfu(flops_bf16, 1.0 / sec_bf16), "compute_dtype": "bf16"})
     cps = batch * seq_len / sec_fused
     return _emit(
         f"charRNN-LSTM train (batch={batch}, T={seq_len}, fused kernel)",
         cps, "chars/sec", BARS["charrnn"],
         {"fused_vs_scan_speedup": round(sec_scan / sec_fused, 3),
          "scan_chars_per_sec": round(batch * seq_len / sec_scan, 1),
-         "mfu": _mfu(flops, 1.0 / sec_fused)})
+         "mfu": _mfu(flops, 1.0 / sec_fused), "compute_dtype": "f32"})
 
 
 def bench_parallel_wrapper(batch_per_dev=128):

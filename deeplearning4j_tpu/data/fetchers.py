@@ -218,25 +218,97 @@ class IrisDataSetIterator(ListDataSetIterator):
         super().__init__(DataSet(x, y), batch_size, shuffle=False)
 
 
-# ---------------------------------------------------------- TinyImageNet
+# ------------------------------------------- directory-tree image datasets
+
+_IMAGE_EXTS = (".jpeg", ".jpg", ".png", ".bmp", ".ppm", ".gif")
+
+
+def load_image_tree(root, image_shape, num_examples=None, num_classes=None,
+                    seed=123):
+    """Read a class-per-directory image tree (the on-disk format of
+    TinyImageNet's train split and LFW) into (x NHWC float [0,1], y int).
+
+    ``root/<class_name>/**/*.jpg`` — class index = sorted directory order
+    (parity: TinyImageNetFetcher.java / LFWDataFetcher.java read the same
+    layouts via DataVec's path-label generators). Images are resized to
+    ``image_shape`` with PIL. Returns None when the tree is absent/empty so
+    callers can fall back to synthetic data."""
+    root = Path(root)
+    if not root.is_dir():
+        return None
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+    class_dirs = sorted(d for d in root.iterdir() if d.is_dir())
+    if not class_dirs:
+        return None
+    if num_classes is None:
+        num_classes = len(class_dirs)
+    h, w, c = image_shape
+    paths, labels = [], []
+    for ci, d in enumerate(class_dirs):
+        for p in sorted(d.rglob("*")):
+            if p.suffix.lower() in _IMAGE_EXTS:
+                paths.append(p)
+                labels.append(ci)
+    if not paths:
+        return None
+    order = np.random.RandomState(seed).permutation(len(paths))
+    if num_examples is not None:
+        order = order[:num_examples]
+    xs = np.empty((len(order), h, w, c), np.float32)
+    ys = np.empty(len(order), np.int64)
+    for k, oi in enumerate(order):
+        img = Image.open(paths[oi])
+        img = img.convert("RGB" if c == 3 else "L")
+        if img.size != (w, h):
+            img = img.resize((w, h))
+        arr = np.asarray(img, np.float32) / 255.0
+        xs[k] = arr[..., None] if c == 1 else arr
+        ys[k] = labels[oi]
+    return xs, ys, num_classes
+
 
 class TinyImageNetDataSetIterator(ListDataSetIterator):
-    """64×64×3, 200 classes (parity: TinyImageNetDataSetIterator)."""
+    """64×64×3, 200 classes (parity: TinyImageNetDataSetIterator). Reads the
+    real dataset from ``<data_dir>/tinyimagenet/{train,val}/`` when present
+    (class-per-directory tree; TinyImageNet's ``<wnid>/images/*.JPEG``
+    nesting is handled by the recursive glob), else deterministic synthetic
+    data with the real shapes."""
 
     def __init__(self, batch_size, num_examples=2000, train=True, seed=123):
-        x, y = _synthetic_images(num_examples, 64, 64, 3, 200,
-                                 seed if train else seed + 1)
+        split = "train" if train else "val"
+        real = load_image_tree(data_dir() / "tinyimagenet" / split,
+                               (64, 64, 3), num_examples, 200, seed)
+        if real is not None:
+            x, y, _ = real
+            _SOURCES["tinyimagenet"] = "real"
+        else:
+            x, y = _synthetic_images(num_examples, 64, 64, 3, 200,
+                                     seed if train else seed + 1)
+            _SOURCES["tinyimagenet"] = "synthetic"
         super().__init__(DataSet(x, _one_hot(y, 200)), batch_size,
                          shuffle=train, seed=seed)
 
 
 class LFWDataSetIterator(ListDataSetIterator):
-    """Labeled-faces-in-the-wild-shaped data (parity: LFWDataSetIterator)."""
+    """Labeled-faces-in-the-wild (parity: LFWDataSetIterator). Reads the
+    real person-per-directory tree from ``<data_dir>/lfw/`` when present,
+    else synthetic data with the real shapes."""
 
     def __init__(self, batch_size, num_examples=1000, num_labels=5749,
                  image_shape=(250, 250, 3), train=True, seed=123):
         h, w, c = image_shape
-        x, y = _synthetic_images(num_examples, h, w, c, num_labels,
-                                 seed if train else seed + 1)
+        real = load_image_tree(data_dir() / "lfw", image_shape,
+                               num_examples, num_labels, seed)
+        if real is not None:
+            x, y, n_found = real
+            num_labels = max(num_labels, n_found)
+            _SOURCES["lfw"] = "real"
+        else:
+            x, y = _synthetic_images(num_examples, h, w, c, num_labels,
+                                     seed if train else seed + 1)
+            _SOURCES["lfw"] = "synthetic"
         super().__init__(DataSet(x, _one_hot(y, num_labels)), batch_size,
                          shuffle=train, seed=seed)

@@ -14,7 +14,7 @@ program per batch.
 """
 
 from deeplearning4j_tpu.nlp.tokenization import (
-    DefaultTokenizerFactory, NGramTokenizerFactory,
+    DefaultTokenizerFactory, NGramTokenizerFactory, CJKTokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.sentence_iterator import (
     CollectionSentenceIterator, BasicLineIterator, FileSentenceIterator,
@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 
 __all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory",
+           "CJKTokenizerFactory",
            "CollectionSentenceIterator", "BasicLineIterator",
            "FileSentenceIterator", "VocabCache", "VocabWord",
            "VocabConstructor", "Word2Vec", "DistributedWord2Vec", "CnnSentenceDataSetIterator", "UnknownWordHandling", "ParagraphVectors", "Glove",

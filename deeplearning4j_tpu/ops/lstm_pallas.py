@@ -51,11 +51,26 @@ def supported(b, t, h, interpret=False):
             and b * 4 * h * 4 <= 2 * 1024 * 1024)     # per-step z ≤ 2 MB
 
 
-def _fwd_inference_kernel(gate_in_ref, rw_ref, h0_ref, c0_ref,
+def _cell_math(z, c, H):
+    """Post-GEMM cell math. Activations run on two contiguous lane blocks
+    (sigmoid over [i|f|o], tanh over g) instead of four per-gate slices."""
+    sp = _sigmoid(z[:, 0:3 * H])
+    g = jnp.tanh(z[:, 3 * H:4 * H])
+    i = sp[:, 0 * H:1 * H]
+    f = sp[:, 1 * H:2 * H]
+    o = sp[:, 2 * H:3 * H]
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    gates = jnp.concatenate([sp, g], axis=-1)
+    return h_new, c_new, gates
+
+
+def _fwd_inference_kernel(K, gate_in_ref, rw_ref, h0_ref, c0_ref,
                           hs_ref, cs_ref, h_s, c_s):
     """Forward without the gates reserve space (parity:
     cudnnRNNForwardInference vs ForwardTraining — saves the (T,B,4H) HBM
-    write when no backward will run)."""
+    write when no backward will run). ``K`` timesteps per grid step
+    (statically unrolled) amortize per-step grid/pipelining overhead."""
     t = pl.program_id(0)
     H = h_s.shape[-1]
 
@@ -64,24 +79,21 @@ def _fwd_inference_kernel(gate_in_ref, rw_ref, h0_ref, c0_ref,
         h_s[:] = h0_ref[:]
         c_s[:] = c0_ref[:]
 
-    z = gate_in_ref[0] + jnp.dot(h_s[:], rw_ref[:],
-                                 preferred_element_type=jnp.float32)
-    i = _sigmoid(z[:, 0 * H:1 * H])
-    f = _sigmoid(z[:, 1 * H:2 * H])
-    o = _sigmoid(z[:, 2 * H:3 * H])
-    g = jnp.tanh(z[:, 3 * H:4 * H])
-    c_new = f * c_s[:] + i * g
-    h_new = o * jnp.tanh(c_new)
-    hs_ref[0] = h_new
-    cs_ref[0] = c_new
-    h_s[:] = h_new
-    c_s[:] = c_new
+    h, c = h_s[:], c_s[:]
+    for k in range(K):
+        z = gate_in_ref[k] + jnp.dot(h, rw_ref[:],
+                                     preferred_element_type=jnp.float32)
+        h, c, _ = _cell_math(z, c, H)
+        hs_ref[k] = h
+        cs_ref[k] = c
+    h_s[:] = h
+    c_s[:] = c
 
 
-def _fwd_kernel(gate_in_ref, rw_ref, h0_ref, c0_ref,
+def _fwd_kernel(K, gate_in_ref, rw_ref, h0_ref, c0_ref,
                 hs_ref, cs_ref, gates_ref, h_s, c_s):
-    """One grid step = one timestep. Scratch (h_s, c_s) persists across the
-    sequentially-executed TPU grid."""
+    """One grid step = K timesteps (statically unrolled). Scratch (h_s, c_s)
+    persists across the sequentially-executed TPU grid."""
     t = pl.program_id(0)
     H = h_s.shape[-1]
 
@@ -90,27 +102,24 @@ def _fwd_kernel(gate_in_ref, rw_ref, h0_ref, c0_ref,
         h_s[:] = h0_ref[:]
         c_s[:] = c0_ref[:]
 
-    z = gate_in_ref[0] + jnp.dot(h_s[:], rw_ref[:],
-                                 preferred_element_type=jnp.float32)
-    i = _sigmoid(z[:, 0 * H:1 * H])
-    f = _sigmoid(z[:, 1 * H:2 * H])
-    o = _sigmoid(z[:, 2 * H:3 * H])
-    g = jnp.tanh(z[:, 3 * H:4 * H])
-    c_new = f * c_s[:] + i * g
-    h_new = o * jnp.tanh(c_new)
-
-    # one full-width store: per-gate slice stores are lane-aligned only when
-    # H % 128 == 0, and Mosaic rejects partial-lane writes for other H
-    gates_ref[0] = jnp.concatenate([i, f, o, g], axis=-1)
-    hs_ref[0] = h_new
-    cs_ref[0] = c_new
-    h_s[:] = h_new
-    c_s[:] = c_new
+    h, c = h_s[:], c_s[:]
+    for k in range(K):
+        z = gate_in_ref[k] + jnp.dot(h, rw_ref[:],
+                                     preferred_element_type=jnp.float32)
+        h, c, gates = _cell_math(z, c, H)
+        # one full-width store: per-gate slice stores are lane-aligned only
+        # when H % 128 == 0; Mosaic rejects partial-lane writes for other H
+        gates_ref[k] = gates
+        hs_ref[k] = h
+        cs_ref[k] = c
+    h_s[:] = h
+    c_s[:] = c
 
 
-def _bwd_kernel(gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
+def _bwd_kernel(K, gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
                 dz_ref, dh0_ref, dc0_ref, dh_rec_s, dc_s):
-    """Reverse-time grid step (index maps flip t). Carries the recurrent
+    """Reverse-time grid step (index maps flip t), K timesteps per grid
+    step walked in reverse inside the block. Carries the recurrent
     gradient dh_rec = dz_{t+1} @ RW^T and dc in scratch."""
     t = pl.program_id(0)
     H = dh_rec_s.shape[-1]
@@ -120,58 +129,73 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
         dh_rec_s[:] = jnp.zeros_like(dh_rec_s)
         dc_s[:] = jnp.zeros_like(dc_s)
 
-    i = gates_ref[0, :, 0 * H:1 * H]
-    f = gates_ref[0, :, 1 * H:2 * H]
-    o = gates_ref[0, :, 2 * H:3 * H]
-    g = gates_ref[0, :, 3 * H:4 * H]
-    c = cs_ref[0]
-    cp = cprev_ref[0]
+    dh_rec = dh_rec_s[:]
+    dc_carry = dc_s[:]
+    for k in reversed(range(K)):
+        i = gates_ref[k, :, 0 * H:1 * H]
+        f = gates_ref[k, :, 1 * H:2 * H]
+        o = gates_ref[k, :, 2 * H:3 * H]
+        g = gates_ref[k, :, 3 * H:4 * H]
+        c = cs_ref[k]
+        cp = cprev_ref[k]
 
-    dh = dhs_ref[0] + dh_rec_s[:]
-    tc = jnp.tanh(c)
-    do = dh * tc
-    dc = dcs_ref[0] + dc_s[:] + dh * o * (1.0 - tc * tc)
-    di = dc * g
-    dg = dc * i
-    df = dc * cp
+        dh = dhs_ref[k] + dh_rec
+        tc = jnp.tanh(c)
+        do = dh * tc
+        dc = dcs_ref[k] + dc_carry + dh * o * (1.0 - tc * tc)
+        di = dc * g
+        dg = dc * i
+        df = dc * cp
 
-    dz = jnp.concatenate([di * i * (1.0 - i), df * f * (1.0 - f),
-                          do * o * (1.0 - o), dg * (1.0 - g * g)], axis=-1)
-    dz_ref[0] = dz
-    # dh_{t-1} recurrent contribution: dz_t @ RW^T  (contract the 4H axis)
-    dh_rec = lax.dot_general(dz, rw_ref[:], (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    dc_prev = dc * f
+        dz = jnp.concatenate([di * i * (1.0 - i), df * f * (1.0 - f),
+                              do * o * (1.0 - o), dg * (1.0 - g * g)],
+                             axis=-1)
+        dz_ref[k] = dz
+        # dh_{t-1} recurrent contribution: dz_t @ RW^T (contract the 4H axis)
+        dh_rec = lax.dot_general(dz, rw_ref[:], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dc_carry = dc * f
     dh_rec_s[:] = dh_rec
-    dc_s[:] = dc_prev
+    dc_s[:] = dc_carry
     # final (t == T-1 in reverse order == timestep 0) carries are the
     # gradients w.r.t. h0/c0; writing every step is fine, last write wins.
     dh0_ref[:] = dh_rec
-    dc0_ref[:] = dc_prev
+    dc0_ref[:] = dc_carry
+
+
+def _steps_per_block(T, B, G):
+    """Largest K in {8, 4, 2, 1} dividing T whose (K, B, 4H) blocks stay
+    within a 2 MB VMEM budget per stream — K timesteps share one grid step,
+    amortizing per-step grid and pipelining overhead ~K-fold."""
+    for K in (8, 4, 2, 1):
+        if T % K == 0 and K * B * G * 4 <= 2 * 1024 * 1024:
+            return K
+    return 1
 
 
 def _fwd_call(gate_in, rw, h0, c0, *, interpret, save_gates=True):
     T, B, G = gate_in.shape
     H = G // 4
+    K = _steps_per_block(T, B, G)
     f32 = jnp.float32
     step_b = lambda t: (t, 0, 0)
     fixed2 = lambda t: (0, 0)
     in_specs = [
-        pl.BlockSpec((1, B, G), step_b, memory_space=pltpu.VMEM),
+        pl.BlockSpec((K, B, G), step_b, memory_space=pltpu.VMEM),
         pl.BlockSpec((H, G), fixed2, memory_space=pltpu.VMEM),
         pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
         pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
     ]
-    state_spec = pl.BlockSpec((1, B, H), step_b, memory_space=pltpu.VMEM)
+    state_spec = pl.BlockSpec((K, B, H), step_b, memory_space=pltpu.VMEM)
     state_shape = jax.ShapeDtypeStruct((T, B, H), f32)
     scratch = [pltpu.VMEM((B, H), f32), pltpu.VMEM((B, H), f32)]
     if save_gates:
         hs, cs, gates = pl.pallas_call(
-            _fwd_kernel,
-            grid=(T,),
+            functools.partial(_fwd_kernel, K),
+            grid=(T // K,),
             in_specs=in_specs,
             out_specs=(state_spec, state_spec,
-                       pl.BlockSpec((1, B, G), step_b,
+                       pl.BlockSpec((K, B, G), step_b,
                                     memory_space=pltpu.VMEM)),
             out_shape=(state_shape, state_shape,
                        jax.ShapeDtypeStruct((T, B, G), f32)),
@@ -180,8 +204,8 @@ def _fwd_call(gate_in, rw, h0, c0, *, interpret, save_gates=True):
         )(gate_in, rw, h0, c0)
         return hs, cs, gates
     hs, cs = pl.pallas_call(
-        _fwd_inference_kernel,
-        grid=(T,),
+        functools.partial(_fwd_inference_kernel, K),
+        grid=(T // K,),
         in_specs=in_specs,
         out_specs=(state_spec, state_spec),
         out_shape=(state_shape, state_shape),
@@ -194,22 +218,24 @@ def _fwd_call(gate_in, rw, h0, c0, *, interpret, save_gates=True):
 def _bwd_call(gates, cs, cprev, rw, dhs, dcs, *, interpret):
     T, B, G = gates.shape
     H = G // 4
+    K = _steps_per_block(T, B, G)
     f32 = jnp.float32
-    rev_b = lambda t: (T - 1 - t, 0, 0)
+    n_blocks = T // K
+    rev_b = lambda t: (n_blocks - 1 - t, 0, 0)
     fixed2 = lambda t: (0, 0)
     dz, dh0, dc0 = pl.pallas_call(
-        _bwd_kernel,
-        grid=(T,),
+        functools.partial(_bwd_kernel, K),
+        grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((1, B, G), rev_b, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, B, H), rev_b, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, B, H), rev_b, memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, B, G), rev_b, memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, B, H), rev_b, memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, B, H), rev_b, memory_space=pltpu.VMEM),
             pl.BlockSpec((H, G), fixed2, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, B, H), rev_b, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, B, H), rev_b, memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, B, H), rev_b, memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, B, H), rev_b, memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, B, G), rev_b, memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, B, G), rev_b, memory_space=pltpu.VMEM),
             pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
             pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
         ),

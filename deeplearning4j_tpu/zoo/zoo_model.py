@@ -9,6 +9,8 @@ network egress, so pretrained weights load from the local cache dir
 
 from __future__ import annotations
 
+import hashlib
+import json
 from pathlib import Path
 from typing import Tuple
 
@@ -55,14 +57,49 @@ class ZooModel:
         from deeplearning4j_tpu.data.fetchers import data_dir
         return data_dir() / "pretrained" / f"{self.name}.zip"
 
+    @staticmethod
+    def _manifest_path() -> Path:
+        from deeplearning4j_tpu.data.fetchers import data_dir
+        return data_dir() / "pretrained" / "manifest.json"
+
+    @staticmethod
+    def write_manifest_entry(name: str, path) -> str:
+        """Record the SHA-256 of a cached pretrained zip in the manifest
+        (the publisher-side half of the integrity check). Returns the hash."""
+        digest = hashlib.sha256(Path(path).read_bytes()).hexdigest()
+        mp = ZooModel._manifest_path()
+        manifest = {}
+        if mp.exists():
+            manifest = json.loads(mp.read_text())
+        manifest[name] = digest
+        mp.parent.mkdir(parents=True, exist_ok=True)
+        mp.write_text(json.dumps(manifest, indent=2))
+        return digest
+
     def init_pretrained(self):
-        """Load pretrained weights from the local cache
-        (parity: ZooModel.initPretrained :40)."""
+        """Load pretrained weights from the local cache, verifying the
+        file's SHA-256 against ``pretrained/manifest.json`` when an entry
+        exists (parity: ZooModel.initPretrained :40 downloads then verifies
+        a checksum — the air gap removes the download, not the integrity
+        check). A corrupt or tampered cache raises instead of silently
+        loading garbage weights."""
         p = self.pretrained_path()
         if not p.exists():
             raise FileNotFoundError(
                 f"No pretrained weights for '{self.name}' at {p}. This "
                 f"environment has no network egress; place a model zip there "
                 f"(util.model_serializer format) to use init_pretrained().")
+        mp = self._manifest_path()
+        if mp.exists():
+            manifest = json.loads(mp.read_text())
+            want = manifest.get(self.name)
+            if want is not None:
+                got = hashlib.sha256(p.read_bytes()).hexdigest()
+                if got != want:
+                    raise IOError(
+                        f"Checksum mismatch for pretrained '{self.name}': "
+                        f"manifest says sha256={want} but {p} hashes to "
+                        f"{got}. The cached file is corrupt or was "
+                        f"replaced — delete it and re-provision.")
         from deeplearning4j_tpu.util.model_serializer import guess_model
         return guess_model(str(p))

