@@ -79,34 +79,43 @@ class LSTM(Layer):
             m = mask_t[:, None]
             h_new = m * h_new + (1 - m) * h
             c_new = m * c_new + (1 - m) * c
-        return h_new, c_new
+        # pin the carry dtype (after the mask blend — masks arrive f32): the
+        # TPU dot lowering can return f32 from a bf16 h @ RW, which would
+        # otherwise break the scan carry contract
+        return h_new.astype(h.dtype), c_new.astype(c.dtype)
 
-    def _fused_supported(self, mask, b, t):
+    def _fused_supported(self, mask, b, t, dt):
         """cuDNN-parity support check (CudnnLSTMHelper supports plain LSTM,
         sigmoid gates, tanh cell, no masking; everything else falls back to
-        the built-in path). Shapes are screened too so the compiled kernel
-        never sees tiles Mosaic can't lay out."""
+        the built-in path). Shapes and dtype are screened too so the
+        compiled kernel never sees tiles Mosaic can't lay out — the kernel
+        runs f32 or bf16 streams natively (f64 gradient checks use the
+        built-in path)."""
         from deeplearning4j_tpu import ops
         from deeplearning4j_tpu.ops.lstm_pallas import supported
         return (ops.helpers_enabled() and mask is None
                 and type(self) is LSTM
                 and self.gate_activation == "sigmoid"
                 and (self.activation or "tanh") == "tanh"
-                and supported(b, t, self.n_out, ops.interpret_mode()))
+                and dt in (jnp.float32, jnp.bfloat16)
+                and supported(b, t, self.n_out, jnp.dtype(dt).itemsize,
+                              ops.interpret_mode()))
 
     def _scan(self, params, x, mask, h0, c0):
         B, T, _ = x.shape
         gate_in = x.reshape(B * T, -1) @ params["W"] + params["b"]
         gate_in = gate_in.reshape(B, T, -1).transpose(1, 0, 2)  # (T, B, 4H)
-        if self._fused_supported(mask, B, T):
+        # compute dtype = the carry dtype apply() derived from (x, W) — NOT
+        # gate_in.dtype: the TPU dot lowering promotes bf16@bf16 to f32,
+        # which would silently upgrade the whole bf16 path
+        dt = h0.dtype
+        if self._fused_supported(mask, B, T, dt):
             from deeplearning4j_tpu import ops
-            dt = x.dtype
-            hs, cs = ops.fused_lstm_sequence(
-                gate_in.astype(jnp.float32), params["RW"].astype(jnp.float32),
-                h0.astype(jnp.float32), c0.astype(jnp.float32),
-                ops.interpret_mode())
-            return (hs.transpose(1, 0, 2).astype(dt),
-                    (hs[-1].astype(dt), cs[-1].astype(dt)))
+            hs, c_last = ops.fused_lstm_sequence(
+                gate_in.astype(dt), params["RW"].astype(dt),
+                h0.astype(dt), c0.astype(dt), ops.interpret_mode())
+            return (hs.transpose(1, 0, 2),
+                    (hs[-1], c_last))
         mask_t = None if mask is None else mask.transpose(1, 0)
 
         def step(carry, inp):
@@ -173,7 +182,7 @@ class GravesLSTM(LSTM):
             m = mask_t[:, None]
             h_new = m * h_new + (1 - m) * h
             c_new = m * c_new + (1 - m) * c
-        return h_new, c_new
+        return h_new.astype(h.dtype), c_new.astype(c.dtype)
 
 
 @register_layer
@@ -216,6 +225,7 @@ class SimpleRnn(Layer):
                 g, m = inp
                 h_new = act(g + h @ params["RW"])
                 h_new = m[:, None] * h_new + (1 - m[:, None]) * h
+            h_new = h_new.astype(h.dtype)
             return h_new, h_new
 
         xs = gate_in if mask is None else (gate_in, mask_t)

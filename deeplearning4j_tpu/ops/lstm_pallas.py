@@ -9,12 +9,28 @@ The TPU-native replacement for the reference's cuDNN fused RNN path
 - runs the whole time loop inside a single kernel launch: the TPU grid is
   executed sequentially, so VMEM scratch carries (h, c) across grid steps
   with zero HBM round-trips,
-- saves the post-activation gates and cell states to a "reserve space"
-  (gates/cs outputs) so the backward pass never recomputes the forward,
+- saves a "reserve space" from the forward (post-activation gates, tanh(c)
+  and c_prev streams) so the backward pass never recomputes the forward,
 - has a hand-written backward kernel that walks the grid in reverse and
   emits per-step pre-activation gate gradients dz; the weight gradients
-  are then two big GEMMs outside the kernel (dW = x^T dz, dRW = h_prev^T dz)
+  are then big GEMMs outside the kernel (dW = x^T dz, dRW = h_prev^T dz)
   — exactly how cudnnRNNBackwardWeights batches its GEMMs.
+
+Streams may be float32 or bfloat16 (the layer passes its compute dtype
+through); all cell math and both carries run in float32 regardless — the
+mixed-precision regime cuDNN uses for fp16 RNNs (fp16 streams, fp32 math).
+
+Performance model (why the design looks like this): at training shapes the
+sequence kernel is HBM-bandwidth-bound — per step it streams the (K,B,4H)
+gate block plus the reserve-space writes — so the wins come from (a) bf16
+streams halving traffic, (b) returning only the FINAL cell state (the full
+cs sequence was a dead output: the layer uses hs + the last carry), and
+(c) storing tanh(c)/c_prev from the forward so the backward neither
+recomputes tanh nor materializes a shifted copy of cs. At small B*H the
+loop is latency-bound instead and XLA's scan codegen beats Mosaic's, so
+``fused_lstm_sequence`` routes the *forward* to an equivalent lax.scan
+below a measured size threshold while keeping the Pallas backward (which
+wins at every validated shape — see KERNELS_TPU.json).
 
 Supported config (like cuDNN's CUDNN_LSTM mode): sigmoid gates, tanh cell
 activation, no peepholes, no step masking. The layer falls back to the
@@ -34,115 +50,175 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+f32 = jnp.float32
 
-def _sigmoid(x):
-    return jax.nn.sigmoid(x)
+# VMEM working budget (v5e has 16 MiB/core; leave headroom for Mosaic's own
+# temporaries). All K sizing and the supported() screen derive from this one
+# number plus the actual per-pass stream footprints — see _pick_k.
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+# Streams per (timestep, batch-row), in units of H elements, for each pass:
+#   fwd inference: gate_in(4H read) + hs(H write)                      = 5H
+#   fwd training:  + tanh_c(H) + c_prev(H) + gates(4H) reserve writes  = 11H
+#   backward:      gates(4H) + tanh_c(H) + c_prev(H) + dhs(H) reads
+#                  + dz(4H) write                                      = 11H
+_ELEMS_INFER = 5
+_ELEMS_TRAIN = 11
+_ELEMS_BWD = 11
+
+# Use the Pallas forward only when the per-step GEMM is wide enough to be
+# bandwidth- rather than latency-bound; below this XLA's scan codegen wins
+# (measured on v5e: (8,·,120) B*H=960 loses at ~0.6x, (16,·,128) B*H=2048
+# is the crossover, (32,·,256)+ wins). The backward kernel wins everywhere.
+_PALLAS_FWD_MIN_BH = 2048
 
 
-def supported(b, t, h, interpret=False):
+def _resident_bytes(b, h, itemsize):
+    """VMEM held for the whole kernel: the RW block + carries/scratch/h0/c0
+    (scratch and carry math are always f32)."""
+    return h * 4 * h * itemsize + 8 * b * h * 4
+
+
+def _pick_k(t, b, h, itemsize, elems_h):
+    """Largest K dividing T whose double-buffered stream blocks plus the
+    resident RW/scratch fit the VMEM budget. Sizing from the TOTAL per-grid-
+    step footprint (all blocked operands x2 for double buffering) — not just
+    one stream — is what keeps Mosaic from oversubscribing VMEM at large
+    B*H (the round-3 failure mode)."""
+    resident = _resident_bytes(b, h, itemsize)
+    for k in (32, 16, 8, 4, 2, 1):
+        if t % k == 0 and 2 * k * b * elems_h * h * itemsize + resident \
+                <= _VMEM_BUDGET:
+            return k
+    return 1
+
+
+def supported(b, t, h, itemsize=4, interpret=False):
     """Shape screen for the compiled kernel (the interpreter has no tiling
-    constraints). Mirrors flash_attention.supported(): lane-aligned hidden
-    size so the per-gate slices hit clean (8,128) tiles, and VMEM bounds for
-    the resident RW block and per-step activations."""
+    constraints): lane-aligned hidden size so the per-gate slices hit clean
+    (8,128) tiles, and the worst pass (backward) must fit VMEM even at
+    K=1 — otherwise Mosaic fails at compile time instead of falling back."""
     if interpret:
         return True
     return (h % 8 == 0
-            and h * 4 * h * 4 <= 4 * 1024 * 1024      # RW block ≤ 4 MB
-            and b * 4 * h * 4 <= 2 * 1024 * 1024)     # per-step z ≤ 2 MB
+            and 2 * b * _ELEMS_BWD * h * itemsize
+            + _resident_bytes(b, h, itemsize) <= _VMEM_BUDGET)
+
+
+def use_pallas_fwd(b, h):
+    """Forward routing: Pallas when bandwidth-bound, lax.scan when the
+    sequential small-GEMM chain is latency-bound (see module docstring)."""
+    return b * h >= _PALLAS_FWD_MIN_BH
 
 
 def _cell_math(z, c, H):
-    """Post-GEMM cell math. Activations run on two contiguous lane blocks
-    (sigmoid over [i|f|o], tanh over g) instead of four per-gate slices."""
-    sp = _sigmoid(z[:, 0:3 * H])
+    """Post-GEMM cell math in f32. Activations run on two contiguous lane
+    blocks (sigmoid over [i|f|o], tanh over g) instead of four per-gate
+    slices. Returns (h, c, tanh(c), gates)."""
+    sp = jax.nn.sigmoid(z[:, 0:3 * H])
     g = jnp.tanh(z[:, 3 * H:4 * H])
     i = sp[:, 0 * H:1 * H]
     f = sp[:, 1 * H:2 * H]
     o = sp[:, 2 * H:3 * H]
     c_new = f * c + i * g
-    h_new = o * jnp.tanh(c_new)
+    tc = jnp.tanh(c_new)
+    h_new = o * tc
     gates = jnp.concatenate([sp, g], axis=-1)
-    return h_new, c_new, gates
+    return h_new, c_new, tc, gates
+
+
+def _gate_z(gate_in_k, h, rw):
+    """z_t = gate_in_t + h_{t-1} @ RW with f32 accumulation. For bf16
+    streams the carry is cast to the stream dtype so the MXU runs its
+    native bf16 x bf16 -> f32 mode (casting RW up instead would materialize
+    an (H,4H) f32 copy every step). Shared by the Pallas kernels (pass
+    ``rw_ref[:]``) and the scan-routed forward, so the two paths cannot
+    desynchronize numerically."""
+    hd = h if rw.dtype == f32 else h.astype(rw.dtype)
+    return gate_in_k.astype(f32) + jnp.dot(hd, rw,
+                                           preferred_element_type=f32)
 
 
 def _fwd_inference_kernel(K, gate_in_ref, rw_ref, h0_ref, c0_ref,
-                          hs_ref, cs_ref, h_s, c_s):
-    """Forward without the gates reserve space (parity:
-    cudnnRNNForwardInference vs ForwardTraining — saves the (T,B,4H) HBM
-    write when no backward will run). ``K`` timesteps per grid step
-    (statically unrolled) amortize per-step grid/pipelining overhead."""
+                          hs_ref, cT_ref, h_s, c_s):
+    """Forward without reserve space (parity: cudnnRNNForwardInference vs
+    ForwardTraining). ``K`` timesteps per grid step (statically unrolled)
+    amortize per-step grid/pipelining overhead. Only hs and the final cell
+    state leave the kernel."""
     t = pl.program_id(0)
     H = h_s.shape[-1]
 
     @pl.when(t == 0)
     def _():
-        h_s[:] = h0_ref[:]
-        c_s[:] = c0_ref[:]
+        h_s[:] = h0_ref[:].astype(f32)
+        c_s[:] = c0_ref[:].astype(f32)
 
     h, c = h_s[:], c_s[:]
     for k in range(K):
-        z = gate_in_ref[k] + jnp.dot(h, rw_ref[:],
-                                     preferred_element_type=jnp.float32)
-        h, c, _ = _cell_math(z, c, H)
-        hs_ref[k] = h
-        cs_ref[k] = c
+        z = _gate_z(gate_in_ref[k], h, rw_ref[:])
+        h, c, _, _ = _cell_math(z, c, H)
+        hs_ref[k] = h.astype(hs_ref.dtype)
     h_s[:] = h
     c_s[:] = c
+    # last write wins == c_{T-1}
+    cT_ref[:] = c.astype(cT_ref.dtype)
 
 
 def _fwd_kernel(K, gate_in_ref, rw_ref, h0_ref, c0_ref,
-                hs_ref, cs_ref, gates_ref, h_s, c_s):
-    """One grid step = K timesteps (statically unrolled). Scratch (h_s, c_s)
-    persists across the sequentially-executed TPU grid."""
+                hs_ref, tc_ref, cprev_ref, gates_ref, cT_ref, h_s, c_s):
+    """Training forward: one grid step = K timesteps (statically unrolled).
+    Scratch (h_s, c_s) persists across the sequentially-executed TPU grid;
+    the reserve space (tanh_c, c_prev, gates) feeds the backward kernel."""
     t = pl.program_id(0)
     H = h_s.shape[-1]
 
     @pl.when(t == 0)
     def _():
-        h_s[:] = h0_ref[:]
-        c_s[:] = c0_ref[:]
+        h_s[:] = h0_ref[:].astype(f32)
+        c_s[:] = c0_ref[:].astype(f32)
 
     h, c = h_s[:], c_s[:]
     for k in range(K):
-        z = gate_in_ref[k] + jnp.dot(h, rw_ref[:],
-                                     preferred_element_type=jnp.float32)
-        h, c, gates = _cell_math(z, c, H)
-        # one full-width store: per-gate slice stores are lane-aligned only
-        # when H % 128 == 0; Mosaic rejects partial-lane writes for other H
-        gates_ref[k] = gates
-        hs_ref[k] = h
-        cs_ref[k] = c
+        cprev_ref[k] = c.astype(cprev_ref.dtype)
+        z = _gate_z(gate_in_ref[k], h, rw_ref[:])
+        h, c, tc, gates = _cell_math(z, c, H)
+        # one full-width gates store: per-gate slice stores are lane-aligned
+        # only when H % 128 == 0; Mosaic rejects partial-lane writes otherwise
+        gates_ref[k] = gates.astype(gates_ref.dtype)
+        hs_ref[k] = h.astype(hs_ref.dtype)
+        tc_ref[k] = tc.astype(tc_ref.dtype)
     h_s[:] = h
     c_s[:] = c
+    cT_ref[:] = c.astype(cT_ref.dtype)
 
 
-def _bwd_kernel(K, gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
+def _bwd_kernel(K, gates_ref, tc_ref, cprev_ref, rw_ref, dhs_ref, dcT_ref,
                 dz_ref, dh0_ref, dc0_ref, dh_rec_s, dc_s):
     """Reverse-time grid step (index maps flip t), K timesteps per grid
     step walked in reverse inside the block. Carries the recurrent
-    gradient dh_rec = dz_{t+1} @ RW^T and dc in scratch."""
+    gradient dh_rec = dz_{t+1} @ RW^T and dc in scratch; dc starts from
+    the final-cell-state cotangent."""
     t = pl.program_id(0)
     H = dh_rec_s.shape[-1]
 
     @pl.when(t == 0)
     def _():
         dh_rec_s[:] = jnp.zeros_like(dh_rec_s)
-        dc_s[:] = jnp.zeros_like(dc_s)
+        dc_s[:] = dcT_ref[:].astype(f32)
 
     dh_rec = dh_rec_s[:]
     dc_carry = dc_s[:]
     for k in reversed(range(K)):
-        i = gates_ref[k, :, 0 * H:1 * H]
-        f = gates_ref[k, :, 1 * H:2 * H]
-        o = gates_ref[k, :, 2 * H:3 * H]
-        g = gates_ref[k, :, 3 * H:4 * H]
-        c = cs_ref[k]
-        cp = cprev_ref[k]
+        i = gates_ref[k, :, 0 * H:1 * H].astype(f32)
+        f = gates_ref[k, :, 1 * H:2 * H].astype(f32)
+        o = gates_ref[k, :, 2 * H:3 * H].astype(f32)
+        g = gates_ref[k, :, 3 * H:4 * H].astype(f32)
+        tc = tc_ref[k].astype(f32)
+        cp = cprev_ref[k].astype(f32)
 
-        dh = dhs_ref[k] + dh_rec
-        tc = jnp.tanh(c)
+        dh = dhs_ref[k].astype(f32) + dh_rec
         do = dh * tc
-        dc = dcs_ref[k] + dc_carry + dh * o * (1.0 - tc * tc)
+        dc = dc_carry + dh * o * (1.0 - tc * tc)
         di = dc * g
         dg = dc * i
         df = dc * cp
@@ -150,10 +226,11 @@ def _bwd_kernel(K, gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
         dz = jnp.concatenate([di * i * (1.0 - i), df * f * (1.0 - f),
                               do * o * (1.0 - o), dg * (1.0 - g * g)],
                              axis=-1)
-        dz_ref[k] = dz
+        dz_ref[k] = dz.astype(dz_ref.dtype)
         # dh_{t-1} recurrent contribution: dz_t @ RW^T (contract the 4H axis)
-        dh_rec = lax.dot_general(dz, rw_ref[:], (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        dzd = dz if rw_ref.dtype == f32 else dz.astype(rw_ref.dtype)
+        dh_rec = lax.dot_general(dzd, rw_ref[:], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=f32)
         dc_carry = dc * f
     dh_rec_s[:] = dh_rec
     dc_s[:] = dc_carry
@@ -163,21 +240,13 @@ def _bwd_kernel(K, gates_ref, cs_ref, cprev_ref, rw_ref, dhs_ref, dcs_ref,
     dc0_ref[:] = dc_carry
 
 
-def _steps_per_block(T, B, G):
-    """Largest K in {8, 4, 2, 1} dividing T whose (K, B, 4H) blocks stay
-    within a 2 MB VMEM budget per stream — K timesteps share one grid step,
-    amortizing per-step grid and pipelining overhead ~K-fold."""
-    for K in (8, 4, 2, 1):
-        if T % K == 0 and K * B * G * 4 <= 2 * 1024 * 1024:
-            return K
-    return 1
-
-
-def _fwd_call(gate_in, rw, h0, c0, *, interpret, save_gates=True):
+def _fwd_call(gate_in, rw, h0, c0, *, interpret, save_reserve):
     T, B, G = gate_in.shape
     H = G // 4
-    K = _steps_per_block(T, B, G)
-    f32 = jnp.float32
+    dt = gate_in.dtype
+    isz = dt.itemsize if hasattr(dt, "itemsize") else jnp.dtype(dt).itemsize
+    K = _pick_k(T, B, H, isz,
+                _ELEMS_TRAIN if save_reserve else _ELEMS_INFER)
     step_b = lambda t: (t, 0, 0)
     fixed2 = lambda t: (0, 0)
     in_specs = [
@@ -187,39 +256,42 @@ def _fwd_call(gate_in, rw, h0, c0, *, interpret, save_gates=True):
         pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
     ]
     state_spec = pl.BlockSpec((K, B, H), step_b, memory_space=pltpu.VMEM)
-    state_shape = jax.ShapeDtypeStruct((T, B, H), f32)
+    fixed_spec = pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM)
+    state_shape = jax.ShapeDtypeStruct((T, B, H), dt)
+    fixed_shape = jax.ShapeDtypeStruct((B, H), dt)
     scratch = [pltpu.VMEM((B, H), f32), pltpu.VMEM((B, H), f32)]
-    if save_gates:
-        hs, cs, gates = pl.pallas_call(
+    if save_reserve:
+        return pl.pallas_call(
             functools.partial(_fwd_kernel, K),
             grid=(T // K,),
             in_specs=in_specs,
-            out_specs=(state_spec, state_spec,
+            out_specs=(state_spec, state_spec, state_spec,
                        pl.BlockSpec((K, B, G), step_b,
-                                    memory_space=pltpu.VMEM)),
-            out_shape=(state_shape, state_shape,
-                       jax.ShapeDtypeStruct((T, B, G), f32)),
+                                    memory_space=pltpu.VMEM),
+                       fixed_spec),
+            out_shape=(state_shape, state_shape, state_shape,
+                       jax.ShapeDtypeStruct((T, B, G), dt), fixed_shape),
             scratch_shapes=scratch,
             interpret=interpret,
-        )(gate_in, rw, h0, c0)
-        return hs, cs, gates
-    hs, cs = pl.pallas_call(
+        )(gate_in, rw, h0, c0)              # hs, tc, cprev, gates, cT
+    hs, cT = pl.pallas_call(
         functools.partial(_fwd_inference_kernel, K),
         grid=(T // K,),
         in_specs=in_specs,
-        out_specs=(state_spec, state_spec),
-        out_shape=(state_shape, state_shape),
+        out_specs=(state_spec, fixed_spec),
+        out_shape=(state_shape, fixed_shape),
         scratch_shapes=scratch,
         interpret=interpret,
     )(gate_in, rw, h0, c0)
-    return hs, cs, None
+    return hs, cT
 
 
-def _bwd_call(gates, cs, cprev, rw, dhs, dcs, *, interpret):
+def _bwd_call(gates, tc, cprev, rw, dhs, dcT, *, interpret):
     T, B, G = gates.shape
     H = G // 4
-    K = _steps_per_block(T, B, G)
-    f32 = jnp.float32
+    dt = gates.dtype
+    isz = jnp.dtype(dt).itemsize
+    K = _pick_k(T, B, H, isz, _ELEMS_BWD)
     n_blocks = T // K
     rev_b = lambda t: (n_blocks - 1 - t, 0, 0)
     fixed2 = lambda t: (0, 0)
@@ -232,7 +304,7 @@ def _bwd_call(gates, cs, cprev, rw, dhs, dcs, *, interpret):
             pl.BlockSpec((K, B, H), rev_b, memory_space=pltpu.VMEM),
             pl.BlockSpec((H, G), fixed2, memory_space=pltpu.VMEM),
             pl.BlockSpec((K, B, H), rev_b, memory_space=pltpu.VMEM),
-            pl.BlockSpec((K, B, H), rev_b, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((K, B, G), rev_b, memory_space=pltpu.VMEM),
@@ -240,47 +312,90 @@ def _bwd_call(gates, cs, cprev, rw, dhs, dcs, *, interpret):
             pl.BlockSpec((B, H), fixed2, memory_space=pltpu.VMEM),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((T, B, G), f32),
+            jax.ShapeDtypeStruct((T, B, G), dt),
             jax.ShapeDtypeStruct((B, H), f32),
             jax.ShapeDtypeStruct((B, H), f32),
         ),
         scratch_shapes=[pltpu.VMEM((B, H), f32), pltpu.VMEM((B, H), f32)],
         interpret=interpret,
-    )(gates, cs, cprev, rw, dhs, dcs)
+    )(gates, tc, cprev, rw, dhs, dcT)
     return dz, dh0, dc0
 
+
+# ------------------------------------------------------- scan-routed forward
+
+def _scan_fwd(gate_in, rw, h0, c0, *, save_reserve):
+    """lax.scan forward on the kernel's exact contract (f32 carries, stream-
+    dtype outputs, same reserve space). Used below the Pallas routing
+    threshold, where the sequential chain is latency-bound."""
+    H = h0.shape[-1]
+    dt = gate_in.dtype
+
+    def step(carry, z_t):
+        h, c = carry
+        z = _gate_z(z_t, h, rw)
+        h2, c2, tc, gates = _cell_math(z, c, H)
+        if save_reserve:
+            out = (h2.astype(dt), tc.astype(dt), c.astype(dt),
+                   gates.astype(dt))
+        else:
+            out = h2.astype(dt)
+        return (h2, c2), out
+
+    (hT, cT), outs = lax.scan(step, (h0.astype(f32), c0.astype(f32)),
+                              gate_in)
+    if save_reserve:
+        hs, tc, cprev, gates = outs
+        return hs, tc, cprev, gates, cT.astype(dt)
+    return outs, cT.astype(dt)
+
+
+# ------------------------------------------------------------- public op
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def fused_lstm_sequence(gate_in, rw, h0, c0, interpret=False):
     """Run a full LSTM over precomputed gate inputs.
 
-    gate_in: (T, B, 4H) = x @ W + b, IFOG gate order.
+    gate_in: (T, B, 4H) = x @ W + b, IFOG gate order, f32 or bf16.
     rw: (H, 4H) recurrent weights. h0/c0: (B, H) initial state.
-    Returns (hs, cs): per-step hidden and cell states, each (T, B, H).
+    Returns (hs, c_last): per-step hidden states (T, B, H) and the final
+    cell state (B, H). (The full cell-state sequence was a dead output —
+    the layer only ever used the last step — so it is not materialized;
+    this halves the inference kernel's write traffic.)
     """
-    # primal (inference-only) call: skip the gates reserve space
-    # (cudnnRNNForwardInference parity); the custom-VJP forward below
-    # re-runs with save_gates=True when a gradient is actually requested.
-    hs, cs, _ = _fwd_call(gate_in, rw, h0, c0, interpret=interpret,
-                          save_gates=False)
-    return hs, cs
+    B, H = h0.shape
+    if not interpret and not use_pallas_fwd(B, H):
+        return _scan_fwd(gate_in, rw, h0, c0, save_reserve=False)
+    return _fwd_call(gate_in, rw, h0, c0, interpret=interpret,
+                     save_reserve=False)
 
 
 def _fused_fwd(gate_in, rw, h0, c0, interpret):
-    hs, cs, gates = _fwd_call(gate_in, rw, h0, c0, interpret=interpret)
-    return (hs, cs), (rw, h0, c0, hs, cs, gates)
+    B, H = h0.shape
+    if not interpret and not use_pallas_fwd(B, H):
+        hs, tc, cprev, gates, cT = _scan_fwd(gate_in, rw, h0, c0,
+                                             save_reserve=True)
+    else:
+        hs, tc, cprev, gates, cT = _fwd_call(gate_in, rw, h0, c0,
+                                             interpret=interpret,
+                                             save_reserve=True)
+    return (hs, cT), (rw, h0, c0, hs, tc, cprev, gates)
 
 
 def _fused_bwd(interpret, res, grads):
-    rw, h0, c0, hs, cs, gates = res
-    dhs, dcs = grads
-    cprev = jnp.concatenate([c0[None], cs[:-1]], axis=0)
-    hprev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
-    dz, dh0, dc0 = _bwd_call(gates, cs, cprev, rw, dhs, dcs,
-                             interpret=interpret)
-    # weight gradient = one big batched GEMM (cudnnRNNBackwardWeights parity)
-    drw = jnp.einsum("tbh,tbg->hg", hprev, dz)
-    return dz, drw, dh0, dc0
+    rw, h0, c0, hs, tc, cprev, gates = res
+    dhs, dcT = grads
+    dz, dh0, dc0 = _bwd_call(gates, tc, cprev, rw,
+                             dhs.astype(gates.dtype),
+                             dcT.astype(gates.dtype), interpret=interpret)
+    # weight gradient = big batched GEMMs (cudnnRNNBackwardWeights parity);
+    # h_prev is expressed as slices of hs (+ the h0 rank-1 term) instead of
+    # materializing a shifted copy.
+    drw = (jnp.einsum("tbh,tbg->hg", hs[:-1], dz[1:],
+                      preferred_element_type=f32)
+           + jnp.einsum("bh,bg->hg", h0.astype(f32), dz[0].astype(f32)))
+    return (dz, drw.astype(rw.dtype),
+            dh0.astype(h0.dtype), dc0.astype(c0.dtype))
 
 
 fused_lstm_sequence.defvjp(_fused_fwd, _fused_bwd)

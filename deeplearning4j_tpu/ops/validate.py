@@ -34,7 +34,8 @@ from deeplearning4j_tpu.ops.flash_attention import (flash_attention,
 
 def _lstm_scan_reference(gate_in, rw, h0, c0):
     """Pure lax.scan LSTM over precomputed gate inputs (the layer's built-in
-    path, restated on the fused kernel's (gate_in, rw, h0, c0) contract)."""
+    path, restated on the fused kernel's (gate_in, rw, h0, c0) contract:
+    returns (hs, c_last))."""
     H = h0.shape[-1]
 
     def step(carry, z_t):
@@ -44,12 +45,13 @@ def _lstm_scan_reference(gate_in, rw, h0, c0):
         f = jax.nn.sigmoid(z[:, 1 * H:2 * H])
         o = jax.nn.sigmoid(z[:, 2 * H:3 * H])
         g = jnp.tanh(z[:, 3 * H:4 * H])
-        c_new = f * c + i * g
-        h_new = o * jnp.tanh(c_new)
-        return (h_new, c_new), (h_new, c_new)
+        # TPU lowering returns f32 from a bf16 dot — pin the carry dtype
+        c_new = (f * c + i * g).astype(c.dtype)
+        h_new = (o * jnp.tanh(c_new)).astype(h.dtype)
+        return (h_new, c_new), h_new
 
-    _, (hs, cs) = lax.scan(step, (h0, c0), gate_in)
-    return hs, cs
+    (_, cT), hs = lax.scan(step, (h0, c0), gate_in)
+    return hs, cT
 
 
 def _attn_reference(q, k, v, causal):
@@ -87,50 +89,60 @@ def _max_err(a, b):
 
 # ---------------------------------------------------------------- LSTM sweep
 
-def validate_lstm_case(b, t, h, rtol=2e-3, atol=2e-4, time_it=True):
+def validate_lstm_case(b, t, h, dtype="float32", rtol=2e-3, atol=2e-4,
+                       time_it=True):
     """Compare fused vs scan outputs and all gradients for one (B, T, H).
 
     Tolerances are backend-honest: on TPU both paths round MXU matmuls at
     bf16-multiply/f32-accumulate default precision with different blocking
     orders, so they agree to ~1e-3 relative, not 1e-5 (the exactness contract
     is pinned by the CPU interpreter tests in tests/test_ops_kernels.py; this
-    sweep exists to catch Mosaic layout/compile bugs, which are O(1) errors)."""
-    assert lstm_pallas.supported(b, t, h), (b, t, h)
+    sweep exists to catch Mosaic layout/compile bugs, which are O(1) errors).
+    bf16 cases compare bf16-fused vs bf16-scan and widen tolerances by the
+    bf16 epsilon ratio."""
+    dt = jnp.dtype(dtype)
+    assert lstm_pallas.supported(b, t, h, dt.itemsize), (b, t, h, dtype)
+    if dt == jnp.bfloat16:
+        rtol, atol = rtol * 16, atol * 16
     rs = np.random.RandomState(h + b + t)
-    gate_in = jnp.asarray(rs.randn(t, b, 4 * h) * 0.4, jnp.float32)
-    rw = jnp.asarray(rs.randn(h, 4 * h) / np.sqrt(h), jnp.float32)
-    h0 = jnp.asarray(rs.randn(b, h) * 0.1, jnp.float32)
-    c0 = jnp.asarray(rs.randn(b, h) * 0.1, jnp.float32)
+    gate_in = jnp.asarray(rs.randn(t, b, 4 * h) * 0.4, dt)
+    rw = jnp.asarray(rs.randn(h, 4 * h) / np.sqrt(h), dt)
+    h0 = jnp.asarray(rs.randn(b, h) * 0.1, dt)
+    c0 = jnp.asarray(rs.randn(b, h) * 0.1, dt)
     cot_h = jnp.asarray(rs.randn(t, b, h), jnp.float32)
-    cot_c = jnp.asarray(rs.randn(t, b, h), jnp.float32)
+    cot_c = jnp.asarray(rs.randn(b, h), jnp.float32)
 
     def loss_fused(gi, rw, h0, c0):
-        hs, cs = lstm_pallas.fused_lstm_sequence(gi, rw, h0, c0)
-        return jnp.sum(hs * cot_h) + jnp.sum(cs * cot_c)
+        hs, cT = lstm_pallas.fused_lstm_sequence(gi, rw, h0, c0)
+        return (jnp.sum(hs.astype(jnp.float32) * cot_h)
+                + jnp.sum(cT.astype(jnp.float32) * cot_c))
 
     def loss_ref(gi, rw, h0, c0):
-        hs, cs = _lstm_scan_reference(gi, rw, h0, c0)
-        return jnp.sum(hs * cot_h) + jnp.sum(cs * cot_c)
+        hs, cT = _lstm_scan_reference(gi, rw, h0, c0)
+        return (jnp.sum(hs.astype(jnp.float32) * cot_h)
+                + jnp.sum(cT.astype(jnp.float32) * cot_c))
 
     fwd_fused = jax.jit(lambda *a: lstm_pallas.fused_lstm_sequence(*a))
     fwd_ref = jax.jit(_lstm_scan_reference)
     g_fused = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2, 3)))
     g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))
 
-    hs_f, cs_f = fwd_fused(gate_in, rw, h0, c0)
-    hs_r, cs_r = fwd_ref(gate_in, rw, h0, c0)
-    errs = {"hs": _max_err(hs_f, hs_r), "cs": _max_err(cs_f, cs_r)}
+    hs_f, cT_f = fwd_fused(gate_in, rw, h0, c0)
+    hs_r, cT_r = fwd_ref(gate_in, rw, h0, c0)
+    errs = {"hs": _max_err(hs_f, hs_r), "cT": _max_err(cT_f, cT_r)}
 
     gf = g_fused(gate_in, rw, h0, c0)
     gr = g_ref(gate_in, rw, h0, c0)
     for name, a, b_ in zip(("dgate_in", "drw", "dh0", "dc0"), gf, gr):
         errs[name] = _max_err(a, b_)
-        scale = float(jnp.max(jnp.abs(b_))) + 1.0
+        scale = float(jnp.max(jnp.abs(b_).astype(jnp.float32))) + 1.0
         assert errs[name] <= atol + rtol * scale, \
             f"LSTM B={b} T={t} H={h}: {name} err {errs[name]} (scale {scale})"
-    assert errs["hs"] <= atol + rtol and errs["cs"] <= atol + rtol * 3, errs
+    assert errs["hs"] <= atol + rtol and errs["cT"] <= atol + rtol * 3, errs
 
-    res = {"kernel": "fused_lstm", "B": b, "T": t, "H": h,
+    res = {"kernel": "fused_lstm", "B": b, "T": t, "H": h, "dtype": dtype,
+           "fwd_route": ("pallas" if lstm_pallas.use_pallas_fwd(b, h)
+                         else "scan"),
            "max_err": round(max(errs.values()), 8)}
     if time_it:
         tf = _time(fwd_fused, gate_in, rw, h0, c0)
@@ -209,14 +221,16 @@ def run(quick=False, time_it=True):
     lstm_cases = LSTM_QUICK if quick else LSTM_SWEEP
     attn_cases = ATTN_QUICK if quick else ATTN_SWEEP
     for b, t, h in lstm_cases:
-        try:
-            r = validate_lstm_case(b, t, h, time_it=time_it)
-            results.append(r)
-            print(json.dumps(r))
-        except Exception as e:  # noqa: BLE001 — report every failing shape
-            failures.append({"kernel": "fused_lstm", "B": b, "T": t, "H": h,
-                             "error": f"{type(e).__name__}: {e}"[:300]})
-            print(json.dumps(failures[-1]))
+        for dtype in ("float32", "bfloat16"):
+            try:
+                r = validate_lstm_case(b, t, h, dtype, time_it=time_it)
+                results.append(r)
+                print(json.dumps(r))
+            except Exception as e:  # noqa: BLE001 — report every failing shape
+                failures.append({"kernel": "fused_lstm", "B": b, "T": t,
+                                 "H": h, "dtype": dtype,
+                                 "error": f"{type(e).__name__}: {e}"[:300]})
+                print(json.dumps(failures[-1]))
     for bh, t, dh in attn_cases:
         for causal in (False, True):
             try:
