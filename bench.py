@@ -44,12 +44,16 @@ BARS = {
 V5E_PEAK_FLOPS = 197e12       # bf16 MXU peak of one v5e chip (MFU denominator)
 
 
+_EMITTED = []        # every metric line, for the final compact summary
+
+
 def _emit(metric, value, unit, bar, extra=None):
     line = {"metric": metric, "value": round(float(value), 1), "unit": unit,
             "vs_baseline": round(float(value) / bar, 3)}
     if extra:
         line.update(extra)
     print(json.dumps(line), flush=True)
+    _EMITTED.append(line)
     return line
 
 
@@ -183,6 +187,32 @@ def bench_resnet50():
     return out
 
 
+def bench_resnet50_imagenet(batch=128, classes=1000):
+    """BASELINE.md row 1: ResNet50 at the reference's default 224x224
+    ImageNet shape (zoo/model/ResNet50.java:1-239), imgs/sec/chip. Data is
+    synthetic (air-gapped chip — no ImageNet on disk), which measures the
+    same compute: the model never sees the data distribution inside one
+    timed step. bf16 is the zoo-default compute dtype on TPU; the MFU
+    denominator is the v5e bf16 peak."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+
+    rs = np.random.RandomState(11)
+    x = jnp.asarray(rs.rand(batch, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(np.eye(classes, dtype=np.float32)[
+        rs.randint(0, classes, size=batch)])
+    cg = ResNet50(num_classes=classes, input_shape=(224, 224, 3), seed=7,
+                  compute_dtype="bfloat16").init()
+    sec, flops = _time_fit_scan(cg, x, y, k=4)
+    ips = batch / sec
+    return _emit(
+        f"ResNet50-ImageNet224 train (batch={batch}, 1 chip, fit_scan, "
+        "bf16)", ips, "imgs/sec", BARS["resnet50"],
+        {"mfu": _mfu(flops, 1.0 / sec), "compute_dtype": "bf16",
+         "data_source": "synthetic", "input_shape": [224, 224, 3],
+         "num_classes": classes})
+
+
 def bench_vgg16(batch=128):
     import jax.numpy as jnp
     from deeplearning4j_tpu.zoo.simple import VGG16
@@ -291,28 +321,38 @@ def bench_parallel_wrapper(batch_per_dev=128):
     sec, _ = _time_fit_scan(pw, x, y, k=64, score=lambda: net._score)
     ips = batch / sec
 
-    # the old regime: one jit dispatch per minibatch from host
+    # the API every reference user holds: plain fit(iterator)
+    # (ParallelWrapper.java:468) — auto-chunked onto the device-resident
+    # scan path by the wrapper. Data travels the host->device link as uint8
+    # with a device-side ImagePreProcessingScaler (the reference's
+    # setPreProcessor pattern, applied on chip): the tunneled attachment
+    # moves ~4-6 MB/s, so wire bytes — not dispatch — bound this path.
     from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.data.iterators import ListDataSetIterator
-    ds = DataSet(x_all, y_all)
-    pw_step = ParallelWrapper(MultiLayerNetwork(_lenet_conf()).init(),
-                              mesh=mesh, averaging_frequency=1)
-    pw_step.fit(ListDataSetIterator(ds, batch))   # warm: build + replicate
-    xp, yp, pad_mask, mf, ml = pw_step._prepare(ds)
-    step = pw_step._step_fn
-    m = pw_step.model
-    st = {"p": m.params, "s": m.state, "o": m.opt_state, "loss": None}
-
-    def one(i):
-        st["p"], st["s"], st["o"], st["loss"] = step(
-            st["p"], st["s"], st["o"], xp, yp, jnp.asarray(i, jnp.int32),
-            pad_mask, mf, ml)
-
-    step_sec = time_python_loop(one, 20, lambda: host_sync(st["loss"]))
+    from deeplearning4j_tpu.data.normalizers import ImagePreProcessingScaler
+    n_batches = 64
+    xs_big = np.concatenate([x_all] * n_batches)
+    ys_big = np.concatenate([y_all] * n_batches)
+    raw = np.clip(xs_big * 255.0, 0, 255).astype(np.uint8)
+    ds = DataSet(raw, ys_big)
+    pw_it = ParallelWrapper(MultiLayerNetwork(_lenet_conf()).init(),
+                            mesh=mesh, averaging_frequency=1)
+    it = ListDataSetIterator(ds, batch)
+    it.set_pre_processor(ImagePreProcessingScaler(device_side=True))
+    pw_it.fit(it)                                # warm: build + compile
+    import statistics
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        pw_it.fit(it)
+        host_sync(pw_it.model._score)
+        ts.append(time.perf_counter() - t0)
+    it_sec = statistics.median(ts)
     return _emit(
         f"ParallelWrapper LeNet DP (devices={n}, batch/dev={batch_per_dev}, "
         "fit_scan)", ips, "imgs/sec", BARS["pw_lenet"] * n,
-        {"per_step_dispatch_imgs_per_sec": round(batch / step_sec, 1)})
+        {"fit_iterator_imgs_per_sec": round(batch * n_batches / it_sec, 1),
+         "fit_iterator_wire": "uint8 + device-side scaler"})
 
 
 def bench_word2vec(n_tokens=200_000, vocab=2000, dim=100):
@@ -357,9 +397,120 @@ def bench_word2vec(n_tokens=200_000, vocab=2000, dim=100):
                  BARS["word2vec"])
 
 
+def bench_accuracy():
+    """Accuracy/quality proof points (not throughput): train-to-accuracy on
+    the recorded data source. The reference's test suites train to a quality
+    bar the same way (zoo TestInstantiation, gradientcheck suites). Three
+    rows: LeNet-MNIST test accuracy, charRNN held-out bits/char vs the
+    uniform-distribution ceiling, Word2Vec topic-similarity margin."""
+    import jax.numpy as jnp
+    from __graft_entry__ import _lenet_conf
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.data.fetchers import load_mnist, data_source
+
+    # --- LeNet on MNIST (real when present; synthetic fallback recorded)
+    xtr, ytr = load_mnist(train=True, num_examples=12800, flatten=False)
+    xte, yte = load_mnist(train=False, num_examples=2000, flatten=False)
+    net = MultiLayerNetwork(_lenet_conf()).init()
+    b = 128
+    steps = len(xtr) // b
+    xs = jnp.asarray(xtr[:steps * b].reshape(steps, b, *xtr.shape[1:]))
+    ys = jnp.asarray(ytr[:steps * b].reshape(steps, b, *ytr.shape[1:]))
+    for _ in range(3):                       # 3 epochs, device-resident
+        net.fit_scan(xs, ys)
+    ev = net.evaluate(ListDataSetIteratorLazy(xte, yte, 500))
+    acc = ev.accuracy()
+    _emit("LeNet-MNIST test accuracy (3 epochs, 12.8k train)",
+          acc * 100.0, "%", 98.5,
+          {"data_source": data_source("mnist"), "n_test": len(xte)})
+
+    # --- charRNN bits/char on a held-out slice of a synthetic Markov text
+    from deeplearning4j_tpu.zoo.simple import TextGenerationLSTM
+    vocab, T, bb = 40, 64, 32
+    rs = np.random.RandomState(3)
+    # order-1 Markov chain with sparse transitions => learnable structure
+    trans = rs.dirichlet(np.ones(vocab) * 0.05, size=vocab)
+    seq = [0]
+    for _ in range(bb * T * 40):
+        seq.append(rs.choice(vocab, p=trans[seq[-1]]))
+    seq = np.asarray(seq[1:])
+    eye = np.eye(vocab, dtype=np.float32)
+
+    def windows(a):
+        n = len(a) // T * T
+        ids = a[:n].reshape(-1, T)
+        return eye[ids], eye[np.roll(ids, -1, axis=1)]
+
+    xw, yw = windows(seq)
+    n_train = len(xw) - bb
+    lstm = TextGenerationLSTM(total_unique_characters=vocab).init()
+    steps = n_train // bb
+    xs = jnp.asarray(xw[:steps * bb].reshape(steps, bb, T, vocab))
+    ys = jnp.asarray(yw[:steps * bb].reshape(steps, bb, T, vocab))
+    for _ in range(2):
+        lstm.fit_scan(xs, ys)
+    held_x, held_y = xw[n_train:], yw[n_train:]
+    nll = float(lstm.score(x=jnp.asarray(held_x), y=jnp.asarray(held_y)))
+    bits = nll / np.log(2.0)
+    _emit(f"charRNN held-out bits/char (synthetic Markov, vocab={vocab})",
+          bits, "bits/char", np.log2(vocab),
+          {"uniform_ceiling_bits": round(float(np.log2(vocab)), 3),
+           "data_source": "synthetic-markov",
+           "note": "lower is better; vs_baseline is fraction of the "
+                   "uniform ceiling"})
+
+    # --- Word2Vec topic-similarity margin on a two-topic corpus
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    a = ["the cat sat on the mat with another cat",
+         "a cat and a kitten play with the mat",
+         "the kitten chased the cat around the mat"]
+    btxt = ["stocks rose as the market rallied today",
+            "the market fell while stocks dropped today",
+            "investors sold stocks as the market crashed"]
+    w2v = Word2Vec(min_word_frequency=3, layer_size=32, window_size=3,
+                   epochs=3, negative=5, seed=7, subsampling=0,
+                   sentences=(a + btxt) * 60)
+    w2v.fit()
+    in_topic = np.mean([w2v.similarity("cat", "kitten"),
+                        w2v.similarity("stocks", "market")])
+    cross = np.mean([w2v.similarity("cat", "stocks"),
+                     w2v.similarity("kitten", "market")])
+    margin = float(in_topic - cross)
+    return _emit("Word2Vec topic-similarity margin (in-topic minus "
+                 "cross-topic cosine)", margin, "cosine", 0.2,
+                 {"in_topic": round(float(in_topic), 3),
+                  "cross_topic": round(float(cross), 3),
+                  "data_source": "synthetic-two-topic"})
+
+
+class ListDataSetIteratorLazy:
+    """Minimal eval iterator over (x, y) without importing test helpers."""
+
+    def __init__(self, x, y, batch):
+        self.x, self.y, self.b = x, y, batch
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if self._pos >= len(self.x):
+            raise StopIteration
+        from deeplearning4j_tpu.data.dataset import DataSet
+        s = slice(self._pos, self._pos + self.b)
+        self._pos += self.b
+        return DataSet(self.x[s], self.y[s])
+
+
 BENCHES = {
     "lenet": bench_lenet,
+    "accuracy": bench_accuracy,
     "resnet50": bench_resnet50,
+    "resnet50_imagenet": bench_resnet50_imagenet,
     "vgg16": bench_vgg16,
     "charrnn": bench_charrnn,
     "parallelwrapper": bench_parallel_wrapper,
@@ -376,14 +527,29 @@ def main(argv=None):
     _force_cpu_if_requested()
     names = a.only or list(BENCHES)
     failures = 0
+    errors = []
     for name in names:
         try:
             BENCHES[name]()
         except Exception as e:  # noqa: BLE001 — one bench must not kill the rest
             failures += 1
+            errors.append(name)
             print(json.dumps({"metric": name, "error":
                               f"{type(e).__name__}: {e}"[:300]}),
                   file=sys.stderr, flush=True)
+    # final compact one-line summary of EVERY metric, printed last so a
+    # bounded tail capture (the driver keeps ~2000 bytes) still records the
+    # whole round: m=metric (abbreviated), v=value, x=vs_baseline, f=mfu
+    def _abbr(m):
+        return (m.replace(" train", "").replace(", 1 chip", "")
+                 .replace(", fit_scan", "").replace("batch=", "b")
+                 .replace("devices=", "d").replace(" ", ""))
+    summary = [{k: v for k, v in
+                (("m", _abbr(l["metric"])), ("v", l["value"]),
+                 ("x", l["vs_baseline"]), ("f", l.get("mfu")))
+                if v is not None} for l in _EMITTED]
+    print(json.dumps({"summary": summary, "errors": errors},
+                     separators=(",", ":")), flush=True)
     return 1 if failures else 0
 
 

@@ -4,11 +4,15 @@ from deeplearning4j_tpu.data.iterators import (
     AsyncDataSetIterator, AsyncMultiDataSetIterator,
     MultipleEpochsIterator, JointParallelDataSetIterator, InequalityHandling,
 )
+from deeplearning4j_tpu.data.streaming import (
+    StreamingDataSetIterator, encode_record, decode_record,
+)
 from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
 )
 
 __all__ = [
+    "StreamingDataSetIterator", "encode_record", "decode_record",
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "ExistingDataSetIterator", "AsyncDataSetIterator",
     "AsyncMultiDataSetIterator", "MultipleEpochsIterator",

@@ -23,7 +23,25 @@ from deeplearning4j_tpu.data.dataset import DataSet
 
 
 class DataSetIterator:
-    """Base contract: iterable of DataSet with reset()."""
+    """Base contract: iterable of DataSet with reset().
+
+    ``set_pre_processor`` attaches a normalizer applied to every emitted
+    batch (parity: DataSetIterator.setPreProcessor). A processor with
+    ``device_side=True`` is NOT applied here — the network containers
+    apply its device transform after the host->device copy, so raw (e.g.
+    uint8) batches travel the wire (see data/normalizers.py)."""
+
+    pre_processor = None
+
+    def set_pre_processor(self, pp):
+        self.pre_processor = pp
+        return self
+
+    def _emit(self, ds: DataSet) -> DataSet:
+        pp = self.pre_processor
+        if pp is not None and not getattr(pp, "device_side", False):
+            ds = pp.pre_process(ds)
+        return ds
 
     def __iter__(self):
         self.reset()
@@ -76,10 +94,10 @@ class ListDataSetIterator(DataSetIterator):
         idx = self._order[self._pos:end]
         self._pos = end
         d = self.dataset
-        return DataSet(
+        return self._emit(DataSet(
             d.features[idx], d.labels[idx],
             None if d.features_mask is None else d.features_mask[idx],
-            None if d.labels_mask is None else d.labels_mask[idx])
+            None if d.labels_mask is None else d.labels_mask[idx]))
 
     def batch(self):
         return self.batch_size
@@ -106,7 +124,7 @@ class ExistingDataSetIterator(DataSetIterator):
             raise StopIteration
         d = self.datasets[self._pos]
         self._pos += 1
-        return d
+        return self._emit(d)
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -154,14 +172,21 @@ class AsyncDataSetIterator(DataSetIterator):
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+        self._consumed = False
 
     def __iter__(self):
-        self.reset()
+        # only restart the worker if this wrapper has already handed out
+        # items: fit() calls reset() and THEN iterates, and a second reset
+        # here would discard prefetched batches — destructive for
+        # forward-only bases (StreamingDataSetIterator)
+        if self._q is None or getattr(self, "_consumed", True):
+            self.reset()
         return self
 
     def __next__(self):
         if self._q is None:
             self.reset()
+        self._consumed = True
         while True:
             try:
                 item = self._q.get(timeout=0.5)
@@ -177,7 +202,8 @@ class AsyncDataSetIterator(DataSetIterator):
             if self._error is not None:
                 raise self._error
             raise StopIteration
-        return item
+        # honor a processor set on THIS wrapper (the base applies its own)
+        return self._emit(item)
 
     def _shutdown(self):
         if self._thread is not None and self._thread.is_alive():
@@ -214,13 +240,13 @@ class MultipleEpochsIterator(DataSetIterator):
 
     def __next__(self):
         try:
-            return next(self.base)
+            return self._emit(next(self.base))
         except StopIteration:
             self._epoch += 1
             if self._epoch >= self.epochs:
                 raise
             self.base.reset()
-            return next(self.base)
+            return self._emit(next(self.base))
 
 
 class InequalityHandling:
@@ -327,3 +353,18 @@ class JointParallelDataSetIterator(DataSetIterator):
         self._heads = [self._EMPTY] * len(self.producers)
         self._stopped = False
         self._cursor = 0
+
+
+def resolve_pre_processor(data):
+    """The pre-processor attached to ``data`` or any wrapped base iterator
+    (Async/MultipleEpochs chains) — used by the containers' fit streams to
+    find a ``device_side`` normalizer that the iterator intentionally did
+    not apply host-side."""
+    d, hops = data, 0
+    while d is not None and hops < 8:
+        pp = getattr(d, "pre_processor", None)
+        if pp is not None:
+            return pp
+        d = getattr(d, "base", None)
+        hops += 1
+    return None

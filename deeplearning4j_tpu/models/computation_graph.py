@@ -289,6 +289,7 @@ class ComputationGraph:
         self.params, self.state, self.opt_state, losses = self._scan_fit(
             self.params, self.state, self.opt_state, inputs_steps,
             labels_steps, jnp.asarray(self.iteration, jnp.int32))
+        self._last_input = [a[-1] for a in inputs_steps]  # activation capture
         self.iteration += int(inputs_steps[0].shape[0])
         self._score = losses[-1]
         for lst in self.listeners:
@@ -309,14 +310,94 @@ class ComputationGraph:
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
-            for batch in data:
-                if isinstance(batch, DataSet):
-                    batch = batch.to_multi()
-                elif not isinstance(batch, MultiDataSet):
-                    batch = MultiDataSet(features=[batch[0]], labels=[batch[1]])
-                self._fit_batch(batch)
+            self._fit_stream(data)
             self.epoch += 1
         return self
+
+    # chunk caps — see MultiLayerNetwork._fit_stream (same design: runs of
+    # mask-free same-shape batches stack onto the device-resident scan path)
+    _CHUNK_MAX_STEPS = 64
+    _CHUNK_MAX_BYTES = 256 << 20
+
+    def _fit_stream(self, data):
+        from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+        from deeplearning4j_tpu.data.iterators import resolve_pre_processor
+
+        # device-side normalizer (see data/normalizers.py); a device_side
+        # processor with no device transform falls back to host application
+        # (same rule as MultiLayerNetwork._fit_stream)
+        pp = resolve_pre_processor(data)
+        dev_fn = host_pp = None
+        if pp is not None and getattr(pp, "device_side", False):
+            f = pp.as_device_transform()
+            if f is not None:
+                dev_fn = jax.jit(f)
+            else:
+                host_pp = pp
+
+        def dev_mds(m):
+            if dev_fn is None:
+                return m
+            return MultiDataSet(
+                features=[dev_fn(jnp.asarray(np.asarray(ff)))
+                          for ff in m.features],
+                labels=m.labels, features_masks=m.features_masks,
+                labels_masks=m.labels_masks)
+
+        chunkable = (getattr(self.conf, "backprop_type", "standard")
+                     != "tbptt")
+        buf, shape = [], None
+
+        def flush():
+            nonlocal buf, shape
+            if not buf:
+                return
+            if len(buf) == 1:
+                self._fit_batch(dev_mds(buf[0]))
+            else:
+                xs = [np.stack([np.asarray(m.features[i]) for m in buf])
+                      for i in range(len(buf[0].features))]
+                if dev_fn is not None:
+                    xs = [dev_fn(jnp.asarray(a)) for a in xs]
+                ys = [np.stack([np.asarray(m.labels[i]) for m in buf])
+                      for i in range(len(buf[0].labels))]
+                self.fit_scan(xs, ys)
+            buf, shape = [], None
+
+        for batch in data:
+            if isinstance(batch, DataSet):
+                batch = batch.to_multi()
+            elif not isinstance(batch, MultiDataSet):
+                batch = MultiDataSet(features=[batch[0]], labels=[batch[1]])
+            if host_pp is not None:
+                batch = MultiDataSet(
+                    features=[host_pp.transform_features(np.asarray(f))
+                              for f in batch.features],
+                    labels=batch.labels, features_masks=batch.features_masks,
+                    labels_masks=batch.labels_masks)
+            has_mask = (
+                (batch.features_masks
+                 and any(m is not None for m in batch.features_masks))
+                or (batch.labels_masks
+                    and any(m is not None for m in batch.labels_masks)))
+            if not chunkable or has_mask:
+                flush()
+                # fallback batches must be normalized too (the iterator
+                # emitted them raw for a device_side processor)
+                self._fit_batch(dev_mds(batch))
+                continue
+            key = (tuple(np.asarray(f).shape for f in batch.features),
+                   tuple(np.asarray(l).shape for l in batch.labels))
+            if shape is not None and key != shape:
+                flush()
+            shape = key
+            buf.append(batch)
+            per = (sum(np.asarray(f).nbytes for f in batch.features)
+                   + sum(np.asarray(l).nbytes for l in batch.labels))
+            if len(buf) >= max(1, min(self._CHUNK_MAX_STEPS,
+                                      self._CHUNK_MAX_BYTES // max(1, per))):
+                flush()
+        flush()
 
     def _fit_batch(self, mds):
         inputs = [jnp.asarray(f) for f in mds.features]

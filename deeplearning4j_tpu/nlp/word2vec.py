@@ -265,6 +265,50 @@ def _cbow_neg_batch(syn0, syn1neg, table, context_mat, context_mask, targets,
     return syn0, syn1neg
 
 
+def _cbow_hs_batch(syn0, syn1, context_mat, context_mask, points, codes,
+                   code_mask, lr, weights=None):
+    """CBOW + hierarchical softmax batch (parity: reference
+    nlp/.../embeddings/learning/impl/elements/CBOW.java:138 — the
+    codes/points branch of iterateSample, on the mean context vector).
+    Reuses the SG-HS math (_sg_hs_step) with the input side swapped from a
+    single center vector to the masked context mean, and the Huffman path
+    taken from the TARGET word: points/codes/code_mask: (B, L)."""
+    B, W = context_mat.shape
+    ctx = syn0[context_mat]                      # (B, W, D)
+    denom = jnp.maximum(context_mask.sum(-1, keepdims=True), 1.0)
+    h = (ctx * context_mask[..., None]).sum(1) / denom   # (B, D)
+    u = syn1[points]                             # (B, L, D)
+    s = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, u))
+    g = (1.0 - codes - s) * lr * code_mask       # grad of -log p
+    if weights is not None:
+        g = g * weights[:, None]
+    dh = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * h[:, None, :]
+    dctx = (dh / denom)[:, None, :] * context_mask[..., None]
+    syn0 = syn0.at[context_mat.reshape(-1)].add(dctx.reshape(B * W, -1))
+    syn1 = syn1.at[points.reshape(-1)].add(du.reshape(-1, du.shape[-1]))
+    return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_epoch(syn0, syn1, ctxs_b, masks_b, pts_b, cds_b, cmsk_b,
+                   weights_b, lrs):
+    """A whole epoch of CBOW-HS batches in ONE compiled lax.scan.
+    ctxs_b/masks_b: (S, B, W); pts_b/cds_b/cmsk_b: (S, B, L);
+    weights_b: (S, B); lrs: (S,)."""
+    def body(carry, inp):
+        syn0, syn1 = carry
+        c, m, p, cd, cm, w, lr = inp
+        syn0, syn1 = _cbow_hs_batch(syn0, syn1, c, m, p, cd, cm, lr,
+                                    weights=w)
+        return (syn0, syn1), jnp.float32(0)
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (ctxs_b, masks_b, pts_b, cds_b, cmsk_b,
+                             weights_b, lrs))
+    return syn0, syn1
+
+
 @partial(jax.jit, static_argnames=("negative",), donate_argnums=(0, 1))
 def _cbow_neg_epoch(syn0, syn1neg, table, ctxs_b, masks_b, targets_b,
                     weights_b, lrs, key, negative):
@@ -297,8 +341,14 @@ class Word2Vec:
         """``negative_sharing=True`` (default) draws each batch's negative
         samples once for the whole batch (candidate sharing) — same unigram
         distribution in expectation, ~3x throughput on TPU because negative
-        gathers/scatters become matmuls. Set False for the reference's
-        strict per-pair sampling (SkipGram.java draws per pair)."""
+        gathers/scatters become matmuls. This is a documented SEMANTIC
+        divergence from the reference, not just a speedup: batch-shared
+        negatives correlate the negative term across the batch's pairs,
+        which raises gradient variance per step (embedding quality on the
+        test corpora is indistinguishable). Set False for the reference's
+        strict per-pair sampling (SkipGram.java draws per pair) — e.g. for
+        parity audits or very small batches, where the correlation is
+        proportionally larger."""
         self.min_word_frequency = min_word_frequency
         self.layer_size = layer_size
         self.window_size = window_size
@@ -377,7 +427,11 @@ class Word2Vec:
             # is one dict-speed pass — catches in-place corpus mutation
             # (same list object, new sentences) that an id()-only key would
             # silently miss
-            sig = (id(self.vocab), len(src), hash(tuple(map(hash, src))))
+            # tokenizer/preprocessor identity is part of the signature:
+            # swapping the factory between fits must invalidate the cache
+            sig = (id(self.vocab), id(self.tokenizer_factory),
+                   id(getattr(self.tokenizer_factory, "preprocessor", None)),
+                   len(src), hash(tuple(map(hash, src))))
         else:
             # non-indexable corpora (SentenceIterator-style) are streamed
             # fresh every fit — no safe identity to cache on
@@ -479,12 +533,23 @@ class Word2Vec:
         return max(64, min(self.batch_size, 8 * self.vocab.num_words()))
 
     # ------------------------------------------------------------------- fit
+    def _huffman_tables(self):
+        """Padded (V, L) Huffman path tables (points, codes, mask) for the
+        HS paths — one row per vocab word."""
+        L = max((len(w.codes) for w in self.vocab.vocab_words()), default=1)
+        V = self.vocab.num_words()
+        pts = np.zeros((V, L), np.int32)
+        cds = np.zeros((V, L), np.float32)
+        msk = np.zeros((V, L), np.float32)
+        for w in self.vocab.vocab_words():
+            l = len(w.codes)
+            # points are inner-node ids; clip negatives (root offset) to 0..V-1
+            pts[w.index, :l] = np.clip(w.points, 0, V - 1)
+            cds[w.index, :l] = w.codes
+            msk[w.index, :l] = 1.0
+        return jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(msk)
+
     def fit(self):
-        if self.algorithm == "cbow" and self.use_hs:
-            raise NotImplementedError(
-                "CBOW + hierarchical softmax is not implemented; use "
-                "negative sampling (use_hierarchic_softmax=False) or "
-                "the skip-gram algorithm with HS")
         if self.vocab is None:
             self.build_vocab()
         if self.syn0 is None:
@@ -523,26 +588,15 @@ class Word2Vec:
 
         seqs = self._encode_corpus()
 
-        if self.use_hs:
-            L = max((len(w.codes) for w in self.vocab.vocab_words()), default=1)
-            V = self.vocab.num_words()
-            pts = np.zeros((V, L), np.int32)
-            cds = np.zeros((V, L), np.float32)
-            msk = np.zeros((V, L), np.float32)
-            for w in self.vocab.vocab_words():
-                l = len(w.codes)
-                # points are inner-node ids; clip negatives (root offset) to 0..V-1
-                pts[w.index, :l] = np.clip(w.points, 0, V - 1)
-                cds[w.index, :l] = w.codes
-                msk[w.index, :l] = 1.0
-            pts_j, cds_j, msk_j = map(jnp.asarray, (pts, cds, msk))
-
         if self.algorithm == "cbow":
             # CBOW trains on (window, target) batches only — running the
             # skip-gram pair loop as well would double-train syn0
+            # (_fit_cbow handles both NEG and HS objectives)
             self._fit_cbow(seqs, rng, key)
             self._norm_cache = None
             return self
+
+        pts_j, cds_j, msk_j = self._huffman_tables()
 
         centers_all, contexts_all = self._make_pairs(seqs, rng)
         bs = self._effective_batch()
@@ -615,12 +669,16 @@ class Word2Vec:
 
     def _fit_cbow(self, seqs, rng, key):
         """CBOW pass: each epoch's (window, target) batches run in one
-        compiled scan (same dispatch-amortization as the skip-gram path)."""
+        compiled scan (same dispatch-amortization as the skip-gram path).
+        use_hierarchic_softmax selects the Huffman-path objective
+        (CBOW.java:138 codes/points branch) instead of negative sampling."""
         ctxs, masks, targets = self._make_cbow_windows(seqs, rng)
         n = len(targets)
         bs = self._effective_batch()
         total = self.epochs * max(1, (n + bs - 1) // bs)
         step_i = 0
+        if self.use_hs:
+            pts_j, cds_j, msk_j = self._huffman_tables()
         for ep in range(self.epochs):
             order = np.random.RandomState(self.seed + ep).permutation(n)
             plan = self._epoch_plan(n, bs, order, step_i, total)
@@ -628,10 +686,17 @@ class Word2Vec:
                 return
             S, sel, w, lrs = plan
             key, sub = jax.random.split(key)
-            self.syn0, self.syn1 = _cbow_neg_epoch(
-                self.syn0, self.syn1, self._table, jnp.asarray(ctxs[sel]),
-                jnp.asarray(masks[sel]), jnp.asarray(targets[sel]),
-                jnp.asarray(w), jnp.asarray(lrs), sub, self.negative)
+            if self.use_hs:
+                t = jnp.asarray(targets[sel])
+                self.syn0, self.syn1 = _cbow_hs_epoch(
+                    self.syn0, self.syn1, jnp.asarray(ctxs[sel]),
+                    jnp.asarray(masks[sel]), pts_j[t], cds_j[t], msk_j[t],
+                    jnp.asarray(w), jnp.asarray(lrs))
+            else:
+                self.syn0, self.syn1 = _cbow_neg_epoch(
+                    self.syn0, self.syn1, self._table, jnp.asarray(ctxs[sel]),
+                    jnp.asarray(masks[sel]), jnp.asarray(targets[sel]),
+                    jnp.asarray(w), jnp.asarray(lrs), sub, self.negative)
             step_i += S
 
     # ------------------------------------------------------------ query API

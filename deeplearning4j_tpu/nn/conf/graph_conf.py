@@ -200,7 +200,11 @@ class LastTimeStepVertex(GraphVertex):
         x = inputs[0]
         if mask is None:
             return x[:, -1, :]
-        idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+        # index of the LAST set mask entry (the reference scans for the last
+        # nonzero — a sum would mis-index gapped/non-left-aligned masks)
+        T = mask.shape[1]
+        idx = T - 1 - jnp.argmax(mask[:, ::-1] > 0, axis=1).astype(jnp.int32)
+        idx = jnp.where(jnp.any(mask > 0, axis=1), idx, 0)
         return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
 
     def output_type(self, input_types):

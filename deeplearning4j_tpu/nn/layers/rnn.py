@@ -336,7 +336,10 @@ class LastTimeStep(Layer):
         y, _ = self.fwd.apply(params, x, None, train=train, rng=rng, mask=mask)
         if mask is None:
             return y[:, -1, :], state
-        idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+        # last SET step, robust to gapped masks (see LastTimeStepVertex)
+        T = mask.shape[1]
+        idx = T - 1 - jnp.argmax(mask[:, ::-1] > 0, axis=1).astype(jnp.int32)
+        idx = jnp.where(jnp.any(mask > 0, axis=1), idx, 0)
         return y[jnp.arange(y.shape[0]), idx, :], state
 
 

@@ -105,26 +105,49 @@ class ParallelWrapper:
         self._scan_fn = None
 
     # ------------------------------------------------------------------ build
-    def _param_sharding(self, leaf):
-        """TP rule: shard the OUTPUT (last) dim of >=2-D kernels and 1-D
-        vectors over the model axis when divisible; replicate otherwise.
-        GSPMD propagates these shards through the graph and inserts the
-        collectives — annotation, not manual communication."""
+    def _param_sharding(self, leaf, path=""):
+        """TP placement rule (Megatron pairing, expressed as GSPMD
+        annotations — XLA inserts the collectives, correctness never depends
+        on the annotation):
+
+        - column-parallel (shard the OUTPUT/last dim): attention Q/K/V
+          projections (sharding the head dim), FFN up-projections, conv
+          kernels' output channels, generic dense kernels;
+        - row-parallel (shard the INPUT/first dim): the second half of each
+          pair — attention output projection ``Wo`` and FFN down-projections
+          — recognized by parameter path (``Wo``/``ff2``/``down``) or by a
+          wide->narrow shape; the activation then stays sharded through the
+          pair with one all-reduce at the row layer's output;
+        - 1-D vectors (biases, LN gamma/beta): replicated — sharding tiny
+          vectors buys nothing and costs collectives.
+        """
         if self.model_axis is None:
             return NamedSharding(self.mesh, P())
-        m = self.mesh.shape[self.model_axis]
-        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
-                and leaf.shape[-1] % m == 0 and leaf.shape[-1] >= m:
-            return NamedSharding(
-                self.mesh, P(*([None] * (leaf.ndim - 1) + [self.model_axis])))
+        ax = self.model_axis
+        m = self.mesh.shape[ax]
+        nd = getattr(leaf, "ndim", 0)
+        if nd >= 2:
+            row_name = any(t in path for t in ("Wo", "ff2", "down"))
+            row_shape = leaf.shape[0] > leaf.shape[-1]
+            if (row_name or (row_shape and not any(
+                    t in path for t in ("Wq", "Wk", "Wv", "ff1", "up")))) \
+                    and leaf.shape[0] % m == 0 and leaf.shape[0] >= m:
+                return NamedSharding(self.mesh,
+                                     P(*([ax] + [None] * (nd - 1))))
+            if leaf.shape[-1] % m == 0 and leaf.shape[-1] >= m:
+                return NamedSharding(self.mesh,
+                                     P(*([None] * (nd - 1) + [ax])))
         return NamedSharding(self.mesh, P())
 
     def _replicated(self, tree):
         """Place params: replicated (pure DP) or TP-sharded (2-D mesh)."""
         if self.model_axis is None:
             return jax.device_put(tree, NamedSharding(self.mesh, P()))
-        return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, self._param_sharding(a)), tree)
+
+        def place(path, a):
+            return jax.device_put(
+                a, self._param_sharding(a, jax.tree_util.keystr(path)))
+        return jax.tree_util.tree_map_with_path(place, tree)
 
     def _grad_update(self, params, state, opt_state, x, y, rng,
                      pad_mask=None, mf=None, ml=None):
@@ -350,23 +373,94 @@ class ParallelWrapper:
             if self._step_fn is None:
                 self._step_fn = self._build_sync_step()
             data_sh = NamedSharding(self.mesh, P("data"))
+
+            # device-side normalizer: raw (e.g. uint8) batches over the
+            # host->device link, transform on chip (data/normalizers.py)
+            from deeplearning4j_tpu.data.iterators import \
+                resolve_pre_processor
+            pp = resolve_pre_processor(data)
+            dev_fn = host_pp = None
+            if pp is not None and getattr(pp, "device_side", False):
+                f = pp.as_device_transform()
+                if f is not None:
+                    dev_fn = jax.jit(f)
+                else:
+                    host_pp = pp   # device-side requested, not expressible
+
+            def fit_one(ds):
+                x, y, pad_mask, mf, ml = self._prepare(ds)
+                if dev_fn is not None:
+                    x = jax.tree_util.tree_map(
+                        lambda a: dev_fn(jnp.asarray(a)), x)
+                if self.model_axis is not None:
+                    x, y, pad_mask, mf, ml = jax.tree_util.tree_map(
+                        lambda a: jax.device_put(jnp.asarray(a), data_sh),
+                        (x, y, pad_mask, mf, ml))
+                model.params, model.state, model.opt_state, loss = \
+                    self._step_fn(model.params, model.state, model.opt_state,
+                                  x, y, jnp.asarray(model.iteration, jnp.int32),
+                                  pad_mask, mf, ml)
+                model._score = loss
+                model.iteration += 1
+                for lst in model.listeners:
+                    lst.iteration_done(model, model.iteration, model.epoch)
+
+            # auto-chunk runs of scan-able batches onto the device-resident
+            # sharded multi-step path (same design as
+            # MultiLayerNetwork._fit_stream: one compiled call per chunk
+            # instead of one host dispatch per minibatch)
+            chunkable = (getattr(model.conf, "backprop_type", "standard")
+                         != "tbptt")
             for _ in range(epochs):
                 if hasattr(data, "reset"):
                     data.reset()
+                buf, shape = [], None
+
+                def flush():
+                    nonlocal buf, shape
+                    if not buf:
+                        return
+                    if len(buf) == 1:
+                        fit_one(buf[0])
+                    else:
+                        # _dp_batch returns numpy VIEWS of the DataSet
+                        # arrays — re-deriving them here costs nothing and
+                        # keeps the buffer to just the DataSets
+                        views = [model._dp_batch(d)[:2] for d in buf]
+                        xs = jax.tree_util.tree_map(
+                            lambda *a: np.stack(a), *[v[0] for v in views])
+                        ys = jax.tree_util.tree_map(
+                            lambda *a: np.stack(a), *[v[1] for v in views])
+                        if dev_fn is not None:
+                            xs = jax.tree_util.tree_map(
+                                lambda a: dev_fn(jnp.asarray(a)), xs)
+                        self.fit_scan(xs, ys)
+                    buf, shape = [], None
+
                 for ds in data:
-                    x, y, pad_mask, mf, ml = self._prepare(ds)
-                    if self.model_axis is not None:
-                        x, y, pad_mask, mf, ml = jax.tree_util.tree_map(
-                            lambda a: jax.device_put(jnp.asarray(a), data_sh),
-                            (x, y, pad_mask, mf, ml))
-                    model.params, model.state, model.opt_state, loss = \
-                        self._step_fn(model.params, model.state, model.opt_state,
-                                      x, y, jnp.asarray(model.iteration, jnp.int32),
-                                      pad_mask, mf, ml)
-                    model._score = loss
-                    model.iteration += 1
-                    for lst in model.listeners:
-                        lst.iteration_done(model, model.iteration, model.epoch)
+                    dsn = ds if isinstance(ds, (DataSet, MultiDataSet)) \
+                        else DataSet(*ds)
+                    if host_pp is not None:
+                        dsn = host_pp.pre_process(dsn)
+                    x, y, mf, ml = model._dp_batch(dsn)
+                    b = jax.tree_util.tree_leaves(x)[0].shape[0]
+                    if (not chunkable or mf is not None or ml is not None
+                            or b % self.n_devices != 0):
+                        flush()
+                        fit_one(dsn)
+                        continue
+                    key = tuple(a.shape for a in
+                                jax.tree_util.tree_leaves((x, y)))
+                    if shape is not None and key != shape:
+                        flush()
+                    shape = key
+                    buf.append(dsn)
+                    per = sum(a.nbytes for a in
+                              jax.tree_util.tree_leaves((x, y)))
+                    if len(buf) >= max(1, min(64, (256 << 20) //
+                                              max(1, per))):
+                        flush()
+                flush()
                 model.epoch += 1
         else:
             if self._step_fn is None:

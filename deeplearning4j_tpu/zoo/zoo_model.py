@@ -53,14 +53,45 @@ class ZooModel:
             return MultiLayerNetwork(conf).init()
         return ComputationGraph(conf).init()
 
-    def pretrained_path(self) -> Path:
+    #: repo-bundled artifacts (trained in-repo by tools/make_pretrained.py,
+    #: committed with their manifest) — the fallback when the user cache has
+    #: no entry, playing the role of the reference's hosted weight files
+    _BUNDLED_DIR = Path(__file__).parent / "pretrained_artifacts"
+
+    def cache_path(self) -> Path:
+        """Where a user-provisioned pretrained zip lives (the WRITE
+        target); ``pretrained_path`` resolves reads across cache+bundle."""
         from deeplearning4j_tpu.data.fetchers import data_dir
         return data_dir() / "pretrained" / f"{self.name}.zip"
+
+    def pretrained_path(self) -> Path:
+        """Read resolution: the user cache when present, else the
+        repo-bundled artifact. Never use as a write target (writing here
+        could clobber the committed bundle) — use ``cache_path``."""
+        cached = self.cache_path()
+        if cached.exists():
+            return cached
+        bundled = self._BUNDLED_DIR / f"{self.name}.zip"
+        return bundled if bundled.exists() else cached
 
     @staticmethod
     def _manifest_path() -> Path:
         from deeplearning4j_tpu.data.fetchers import data_dir
         return data_dir() / "pretrained" / "manifest.json"
+
+    @classmethod
+    def manifest(cls) -> dict:
+        """Merged manifest: user-cache entries override the bundled ones.
+        Values are either a bare sha256 string (legacy) or a dict with
+        ``sha256`` plus recorded eval metadata."""
+        merged = {}
+        bundled = cls._BUNDLED_DIR / "manifest.json"
+        if bundled.exists():
+            merged.update(json.loads(bundled.read_text()))
+        mp = cls._manifest_path()
+        if mp.exists():
+            merged.update(json.loads(mp.read_text()))
+        return merged
 
     @staticmethod
     def write_manifest_entry(name: str, path) -> str:
@@ -89,17 +120,27 @@ class ZooModel:
                 f"No pretrained weights for '{self.name}' at {p}. This "
                 f"environment has no network egress; place a model zip there "
                 f"(util.model_serializer format) to use init_pretrained().")
-        mp = self._manifest_path()
-        if mp.exists():
-            manifest = json.loads(mp.read_text())
-            want = manifest.get(self.name)
-            if want is not None:
-                got = hashlib.sha256(p.read_bytes()).hexdigest()
-                if got != want:
-                    raise IOError(
-                        f"Checksum mismatch for pretrained '{self.name}': "
-                        f"manifest says sha256={want} but {p} hashes to "
-                        f"{got}. The cached file is corrupt or was "
-                        f"replaced — delete it and re-provision.")
+        # validate against the manifest that SHIPPED WITH this file's
+        # source: a user-provisioned cache zip checks the cache manifest
+        # (none -> unchecked, as before the bundle existed), a bundled zip
+        # checks the committed bundle manifest — so a user's own lenet.zip
+        # is never rejected against the bundled artifact's hash
+        if p.parent == self._BUNDLED_DIR:
+            mf = self._BUNDLED_DIR / "manifest.json"
+        else:
+            mf = self._manifest_path()
+        want = None
+        if mf.exists():
+            want = json.loads(mf.read_text()).get(self.name)
+        if isinstance(want, dict):
+            want = want.get("sha256")
+        if want is not None:
+            got = hashlib.sha256(p.read_bytes()).hexdigest()
+            if got != want:
+                raise IOError(
+                    f"Checksum mismatch for pretrained '{self.name}': "
+                    f"manifest says sha256={want} but {p} hashes to "
+                    f"{got}. The cached file is corrupt or was "
+                    f"replaced — delete it and re-provision.")
         from deeplearning4j_tpu.util.model_serializer import guess_model
         return guess_model(str(p))

@@ -197,3 +197,50 @@ def test_cjk_tokenizer_and_chinese_w2v():
     vocab_words = [w2v.vocab.word_at_index(i)
                    for i in range(w2v.vocab.num_words())]
     assert any(any(_c in w for _c in "猫狗宠毛") for w in vocab_words)
+
+
+def test_word2vec_cbow_hierarchical_softmax():
+    """CBOW + HS (reference CBOW.java:138 codes/points branch) learns the
+    same topic structure as the other three objective combinations."""
+    w2v = Word2Vec(min_word_frequency=3, layer_size=24, window_size=3,
+                   epochs=8, seed=7, sentences=_corpus(), subsampling=0,
+                   use_hierarchic_softmax=True,
+                   elements_learning_algorithm="cbow")
+    w2v.fit()
+    assert w2v.similarity("stocks", "market") > \
+        w2v.similarity("stocks", "kitten") + 0.1
+
+
+def test_cbow_hs_batch_matches_autodiff():
+    """The hand-written CBOW-HS scatter update equals -lr * d(loss)/d(params)
+    of the Huffman-path NLL at the same point (single-occurrence indices, so
+    batched scatter == sequential SGD)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nlp.word2vec import _cbow_hs_batch
+
+    rs = np.random.RandomState(3)
+    V, D, B, W, L = 12, 8, 2, 3, 4
+    syn0 = jnp.asarray(rs.randn(V, D) * 0.3, jnp.float32)
+    syn1 = jnp.asarray(rs.randn(V, D) * 0.3, jnp.float32)
+    # disjoint context/point indices so scatter-adds don't overlap
+    ctx = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
+    msk = jnp.asarray([[1, 1, 0], [1, 1, 1]], jnp.float32)
+    pts = jnp.asarray([[6, 7, 0, 0], [8, 9, 10, 0]], jnp.int32)
+    cds = jnp.asarray([[1, 0, 0, 0], [0, 1, 1, 0]], jnp.float32)
+    cmsk = jnp.asarray([[1, 1, 0, 0], [1, 1, 1, 0]], jnp.float32)
+    lr = 0.1
+
+    def loss(syn0, syn1):
+        h = (syn0[ctx] * msk[..., None]).sum(1) / msk.sum(-1, keepdims=True)
+        s = jnp.einsum("bd,bld->bl", h, syn1[pts])
+        # -log sigmoid((1-2c)s) summed over the valid path
+        return jnp.sum(jax.nn.softplus(-(1.0 - 2.0 * cds) * s) * cmsk)
+
+    g0, g1 = jax.grad(loss, argnums=(0, 1))(syn0, syn1)
+    n0, n1 = _cbow_hs_batch(syn0, syn1, ctx, msk, pts, cds, cmsk,
+                            jnp.float32(lr))
+    np.testing.assert_allclose(np.asarray(n0 - syn0), np.asarray(-lr * g0),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(n1 - syn1), np.asarray(-lr * g1),
+                               rtol=1e-4, atol=1e-5)

@@ -339,3 +339,105 @@ def test_fit_scan_tp_dp_matches_single_device():
             np.testing.assert_allclose(
                 np.asarray(p_tp[k]), np.asarray(p_ref[k]),
                 rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+class TestTensorParallelRealModels:
+    """TP x DP exactness on realistic models: TinyTransformer (attention
+    heads / FFN sharded Megatron-style) and a conv net (output channels
+    sharded). GSPMD guarantees semantics regardless of annotation; these
+    tests pin that guarantee to 1e-5-level parity against single-device
+    training."""
+
+    def _tt(self):
+        from deeplearning4j_tpu.zoo.simple import TinyTransformer
+        # SGD, not Adam: the K-projection bias is softmax-invariant (its
+        # exact gradient is 0), and Adam's 1/sqrt(v) normalization blows
+        # pure fp reduction noise on it up to update-sized diffs
+        return TinyTransformer(vocab_size=16, n_layers=2, d_model=32,
+                               n_heads=4, seed=5, updater=Sgd(0.05)).init()
+
+    @staticmethod
+    def _tt_data(n=16, T=12, vocab=16):
+        rs = np.random.RandomState(4)
+        ids = rs.randint(0, vocab, size=(n, T))
+        eye = np.eye(vocab, dtype=np.float32)
+        return eye[ids], eye[np.roll(ids, -1, axis=1)]
+
+    def test_tinytransformer_tp_dp_matches_single_device(self):
+        import jax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+        x, y = self._tt_data()
+        ref = self._tt()
+        for i in range(0, 16, 8):
+            ref.fit(DataSet(x[i:i + 8], y[i:i + 8]))
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                    ("data", "model"))
+        net = self._tt()
+        pw = ParallelWrapper(net, mesh=mesh)
+        pw.fit(ListDataSetIterator(DataSet(x, y), 8))
+
+        for name in ref.params:
+            for k in ref.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(net.params[name][k]),
+                    np.asarray(ref.params[name][k]),
+                    rtol=1e-4, atol=1e-5, err_msg=f"{name}/{k}")
+
+    def test_tinytransformer_tp_placement(self):
+        """Q/K/V kernels shard the head (output) dim; Wo and ff2 shard the
+        input dim (row-parallel); LN vectors stay replicated."""
+        import jax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                    ("data", "model"))
+        net = self._tt()
+        pw = ParallelWrapper(net, mesh=mesh)
+        x, y = self._tt_data()
+        pw.fit(ListDataSetIterator(DataSet(x, y), 8))
+        p = net.params
+        assert p["b0_attn"]["Wq"].sharding.spec[-1] == "model"
+        assert p["b0_attn"]["Wo"].sharding.spec[0] == "model"
+        assert p["b0_ff2"]["W"].sharding.spec[0] == "model"
+        # the placement RULE replicates 1-D vectors (GSPMD may still choose
+        # its own layout for outputs after the step — that is its call)
+        spec = pw._param_sharding(np.zeros(32), "b0_ln1/gamma").spec
+        assert all(s is None for s in spec), spec
+
+    def test_conv_tp_dp_matches_single_device(self):
+        import jax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        from __graft_entry__ import _lenet_conf
+
+        rs = np.random.RandomState(1)
+        x = rs.rand(16, 16, 16, 1).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 16)]
+
+        ref = MultiLayerNetwork(_lenet_conf(height=16, width=16)).init()
+        for i in range(0, 16, 8):
+            ref.fit(DataSet(x[i:i + 8], y[i:i + 8]))
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                    ("data", "model"))
+        net = MultiLayerNetwork(_lenet_conf(height=16, width=16)).init()
+        pw = ParallelWrapper(net, mesh=mesh)
+        pw.fit(ListDataSetIterator(DataSet(x, y), 8))
+
+        # conv reductions reorder under sharding; tolerance stays at
+        # fp-noise level (worst observed: 1 element at 2.5e-5)
+        for p_tp, p_ref in zip(net.params, ref.params):
+            for k in p_ref:
+                np.testing.assert_allclose(
+                    np.asarray(p_tp[k]), np.asarray(p_ref[k]),
+                    rtol=2e-4, atol=1e-4, err_msg=k)

@@ -104,3 +104,85 @@ class TestPipelineTrainer:
     def test_bad_microbatch_split(self):
         with pytest.raises(ValueError):
             split_microbatches(np.zeros((10, 3)), 4)
+
+
+def test_device_side_preprocessor_matches_host_side():
+    """uint8 batches + ImagePreProcessingScaler(device_side=True): the
+    containers apply the transform on device after the copy; the result
+    must equal host-side scaling exactly (fit(iterator) both ways)."""
+    import numpy as np
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.data.normalizers import ImagePreProcessingScaler
+
+    def net():
+        conf = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(DenseLayer(n_in=12, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(0)
+    raw = rs.randint(0, 256, size=(64, 12)).astype(np.uint8)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 64)]
+
+    n_dev = net()
+    it = ListDataSetIterator(DataSet(raw, y), 16)
+    it.set_pre_processor(ImagePreProcessingScaler(device_side=True))
+    n_dev.fit(it, epochs=2)
+
+    n_host = net()
+    it2 = ListDataSetIterator(DataSet(raw, y), 16)
+    it2.set_pre_processor(ImagePreProcessingScaler())   # host-side
+    n_host.fit(it2, epochs=2)
+
+    for pd, ph in zip(n_dev.params, n_host.params):
+        for k in pd:
+            np.testing.assert_allclose(np.asarray(pd[k]), np.asarray(ph[k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_device_side_standardize_handles_chunked_batches():
+    """NormalizerStandardize(device_side=True) must work through the
+    chunked fit path (stacked (S,B,F) blocks) and match host-side
+    standardization."""
+    import numpy as np
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.data.normalizers import NormalizerStandardize
+
+    def net():
+        conf = (NeuralNetConfiguration.builder().seed(5).list()
+                .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(2)
+    x = (rs.rand(64, 6) * 7 + 3).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 64)]
+
+    norm_dev = NormalizerStandardize(device_side=True)
+    norm_dev.fit(DataSet(x, y))
+    n_dev = net()
+    it = ListDataSetIterator(DataSet(x, y), 16)
+    it.set_pre_processor(norm_dev)
+    n_dev.fit(it, epochs=2)
+
+    norm_host = NormalizerStandardize()
+    norm_host.fit(DataSet(x, y))
+    n_host = net()
+    it2 = ListDataSetIterator(DataSet(x.copy(), y), 16)
+    it2.set_pre_processor(norm_host)
+    n_host.fit(it2, epochs=2)
+
+    for pd, ph in zip(n_dev.params, n_host.params):
+        for k in pd:
+            np.testing.assert_allclose(np.asarray(pd[k]), np.asarray(ph[k]),
+                                       rtol=1e-4, atol=1e-5, err_msg=k)

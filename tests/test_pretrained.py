@@ -1,0 +1,58 @@
+"""Repo-bundled pretrained artifacts: init_pretrained() must verify the
+manifest checksum and reproduce the recorded accuracy end-to-end (parity
+role: reference zoo TestInstantiation + ZooModel.initPretrained:40)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo.simple import LeNet, SimpleCNN
+from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+
+
+def _manifest():
+    p = ZooModel._BUNDLED_DIR / "manifest.json"
+    if not p.exists():
+        pytest.skip("no bundled pretrained artifacts")
+    return json.loads(p.read_text())
+
+
+def test_lenet_pretrained_reproduces_recorded_accuracy():
+    from deeplearning4j_tpu.data.fetchers import load_mnist
+    entry = _manifest()["lenet"]
+    net = LeNet(num_classes=10).init_pretrained()
+    xte, yte = load_mnist(train=False, num_examples=entry["n_test"],
+                          flatten=False)
+    pred = np.asarray(net.output(xte))
+    acc = float((pred.argmax(-1) == yte.argmax(-1)).mean())
+    assert abs(acc - entry["accuracy"]) < 0.02, (acc, entry["accuracy"])
+    assert acc > 0.95
+
+
+def test_simplecnn_pretrained_reproduces_recorded_accuracy():
+    from deeplearning4j_tpu.data.fetchers import _synthetic_images, _one_hot
+    entry = _manifest()["simplecnn"]
+    net = SimpleCNN(num_classes=entry["n_classes"]).init_pretrained()
+    xte, yte_i = _synthetic_images(entry["n_test"], 48, 48, 3,
+                                   entry["n_classes"],
+                                   seed=entry["test_seed"])
+    pred = np.asarray(net.output(xte))
+    acc = float((pred.argmax(-1) == yte_i).mean())
+    assert abs(acc - entry["accuracy"]) < 0.02, (acc, entry["accuracy"])
+    assert acc > 0.95
+
+
+def test_pretrained_checksum_guards_tampering(tmp_path, monkeypatch):
+    """A tampered cached zip must be rejected by the manifest check."""
+    entry = _manifest()["lenet"]
+    cache = tmp_path / "pretrained"
+    cache.mkdir()
+    src = ZooModel._BUNDLED_DIR / "lenet.zip"
+    bad = bytearray(src.read_bytes())
+    bad[-1] ^= 0xFF
+    (cache / "lenet.zip").write_bytes(bytes(bad))
+    (cache / "manifest.json").write_text(json.dumps({"lenet": entry}))
+    monkeypatch.setenv("DL4JTPU_DATA_DIR", str(tmp_path))
+    with pytest.raises(IOError):
+        LeNet(num_classes=10).init_pretrained()

@@ -1,0 +1,104 @@
+"""Streaming ingest tests (parity role: dl4j-streaming's Kafka route tests —
+producer thread feeds records, training consumes DataSets; see
+deeplearning4j_tpu/data/streaming.py)."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    StreamingDataSetIterator, encode_record, decode_record, DataSet,
+)
+from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
+
+
+def test_two_thread_stream_batches_and_tail():
+    it = StreamingDataSetIterator(batch_size=8, buffer_records=64)
+    n = 35   # 4 full batches + tail of 3
+
+    def producer():
+        rs = np.random.RandomState(0)
+        for i in range(n):
+            it.push(rs.rand(4).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[i % 3])
+            if i % 10 == 0:
+                time.sleep(0.002)      # interleave with the consumer
+        it.end()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    sizes, total = [], 0
+    for ds in it:
+        assert ds.features.shape[1:] == (4,)
+        assert ds.labels.shape[1:] == (3,)
+        sizes.append(ds.num_examples())
+        total += ds.num_examples()
+    t.join()
+    assert total == n
+    assert sizes == [8, 8, 8, 8, 3]
+
+
+def test_stream_prebatched_blocks_and_drop_remainder():
+    it = StreamingDataSetIterator(batch_size=4, drop_remainder=True)
+    it.push(np.ones((6, 2), np.float32), np.ones((6, 1), np.float32),
+            batched=True)
+    it.end()
+    out = list(it)
+    assert len(out) == 1 and out[0].num_examples() == 4
+
+
+def test_stream_backpressure_and_closed_push():
+    it = StreamingDataSetIterator(batch_size=2, buffer_records=2,
+                                  push_timeout=0.05)
+    it.push(np.zeros(2), np.zeros(1))
+    it.push(np.zeros(2), np.zeros(1))
+    with pytest.raises(queue.Full):     # bounded buffer pushes back
+        it.push(np.zeros(2), np.zeros(1))
+    it.end()
+    with pytest.raises(RuntimeError):
+        it.push(np.zeros(2), np.zeros(1))
+    assert next(it).num_examples() == 2
+
+
+def test_wire_codec_roundtrip():
+    f = np.random.RandomState(1).rand(5, 7).astype(np.float32)
+    l = np.asarray([1, 0, 2], np.int32)
+    f2, l2 = decode_record(encode_record(f, l))
+    np.testing.assert_array_equal(f, f2)
+    np.testing.assert_array_equal(l, l2)
+    assert f2.dtype == f.dtype and l2.dtype == l.dtype
+
+
+def test_stream_feeds_fit_through_async_prefetch():
+    """End-to-end: producer thread → streaming iterator → async prefetch →
+    MultiLayerNetwork.fit (the NDArrayPubSubRoute consumer role)."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    it = StreamingDataSetIterator(batch_size=16)
+
+    def producer():
+        rs = np.random.RandomState(2)
+        for _ in range(8):
+            x = rs.rand(16, 6).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[(x.sum(1) > 3).astype(int)]
+            it.push(x, y, batched=True)
+        it.end()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    net.fit(AsyncDataSetIterator(it, queue_size=2))
+    t.join()
+    assert net.iteration == 8
+    assert np.isfinite(net.get_score())

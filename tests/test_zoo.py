@@ -139,7 +139,7 @@ def test_pretrained_checksum_verification(tmp_path, monkeypatch):
     monkeypatch.setenv("DL4JTPU_DATA_DIR", str(tmp_path))
     model = LeNet(num_classes=10, input_shape=(28, 28, 1))
     net = model.init()
-    p = model.pretrained_path()
+    p = model.cache_path()   # the WRITE target — never the bundled artifact
     p.parent.mkdir(parents=True, exist_ok=True)
     write_model(net, str(p))
     ZooModel.write_manifest_entry(model.name, p)

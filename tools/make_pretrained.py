@@ -1,0 +1,91 @@
+"""Train and publish the repo-bundled pretrained zoo artifacts.
+
+The reference's ``ZooModel.initPretrained()`` (zoo/ZooModel.java:40) serves
+actually-trained weights from a hosted cache. This air-gapped runtime cannot
+download, so the artifacts are trained HERE, committed under
+``deeplearning4j_tpu/zoo/pretrained_artifacts/`` with a manifest recording
+each zip's SHA-256 and its evaluated accuracy on a deterministic test set;
+``tests/test_pretrained.py`` reloads every artifact and reproduces the
+recorded accuracy end-to-end.
+
+Run from the repo root (CPU is fine — the models are small):
+    JAX_PLATFORMS=cpu python tools/make_pretrained.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = (Path(__file__).resolve().parent.parent / "deeplearning4j_tpu" / "zoo"
+       / "pretrained_artifacts")
+
+
+def _fit_eval(net, xtr, ytr, xte, yte, batch, epochs):
+    import jax.numpy as jnp
+    steps = len(xtr) // batch
+    xs = jnp.asarray(xtr[:steps * batch].reshape(steps, batch,
+                                                 *xtr.shape[1:]))
+    ys = jnp.asarray(ytr[:steps * batch].reshape(steps, batch,
+                                                 *ytr.shape[1:]))
+    for _ in range(epochs):
+        net.fit_scan(xs, ys)
+    pred = np.asarray(net.output(xte))
+    acc = float((pred.argmax(-1) == yte.argmax(-1)).mean())
+    return acc
+
+
+def train_lenet():
+    from deeplearning4j_tpu.zoo.simple import LeNet
+    from deeplearning4j_tpu.data.fetchers import load_mnist, data_source
+    xtr, ytr = load_mnist(train=True, num_examples=12800, flatten=False)
+    xte, yte = load_mnist(train=False, num_examples=2000, flatten=False)
+    net = LeNet(num_classes=10).init()
+    acc = _fit_eval(net, xtr, ytr, xte, yte, batch=128, epochs=3)
+    return net, acc, {"dataset": "mnist", "source": data_source("mnist"),
+                      "n_train": 12800, "n_test": 2000, "epochs": 3}
+
+
+def train_simplecnn():
+    from deeplearning4j_tpu.zoo.simple import SimpleCNN
+    from deeplearning4j_tpu.data.fetchers import _synthetic_images, _one_hot
+    n_classes = 5
+    xtr, ytr_i = _synthetic_images(4000, 48, 48, 3, n_classes, seed=11)
+    xte, yte_i = _synthetic_images(800, 48, 48, 3, n_classes, seed=77)
+    ytr, yte = _one_hot(ytr_i, n_classes), _one_hot(yte_i, n_classes)
+    net = SimpleCNN(num_classes=n_classes).init()
+    acc = _fit_eval(net, xtr, ytr, xte, yte, batch=100, epochs=3)
+    return net, acc, {"dataset": "synthetic-images-48x48",
+                      "source": "synthetic", "n_classes": n_classes,
+                      "train_seed": 11, "test_seed": 77,
+                      "n_train": 4000, "n_test": 800, "epochs": 3}
+
+
+def main():
+    from deeplearning4j_tpu.util.model_serializer import write_model
+    OUT.mkdir(parents=True, exist_ok=True)
+    manifest_p = OUT / "manifest.json"
+    manifest = json.loads(manifest_p.read_text()) if manifest_p.exists() \
+        else {}
+    for name, trainer in (("lenet", train_lenet),
+                          ("simplecnn", train_simplecnn)):
+        net, acc, meta = trainer()
+        path = OUT / f"{name}.zip"
+        write_model(net, str(path), save_updater=False)
+        digest = hashlib.sha256(path.read_bytes()).hexdigest()
+        manifest[name] = {"sha256": digest,
+                          "accuracy": round(acc, 4), **meta}
+        print(f"{name}: accuracy={acc:.4f} sha256={digest[:16]}… "
+              f"size={path.stat().st_size // 1024}KB")
+    manifest_p.write_text(json.dumps(manifest, indent=2))
+    print(f"wrote {manifest_p}")
+
+
+if __name__ == "__main__":
+    main()
