@@ -82,14 +82,16 @@ def _tile_steps(a, k):
 def _time_fit_scan(model, x, y, k=64, repeats=5, score=None):
     """Seconds per train step via the device-resident fit_scan path: k steps
     run inside ONE compiled call; the fixed dispatch+read cost is removed by
-    differencing a k-step run against a k/8-step run. The host-read RPC's
-    latency is bimodal here, so the representative value is the MEDIAN of
-    ``repeats`` runs (min would pick the rare fast-path outlier).
+    differencing a k-step run against a k/8-step run. The attached chip sits
+    in a SHARED pool: tenancy contention inflates whole runs by up to ~1.7x
+    for seconds at a time, so the representative value is the MIN of
+    ``repeats`` runs — contention only ever adds time, and the k-step vs
+    k/8-step differencing already cancels the fixed RPC cost that once
+    argued for a median.
 
     ``model`` is anything with a ``fit_scan(xs, ys)`` (a container or a
     ParallelWrapper); ``score`` returns the device scalar to sync on
     (defaults to ``model._score``)."""
-    import statistics
     from deeplearning4j_tpu.util.timing import host_sync
 
     score = score or (lambda: model._score)
@@ -103,25 +105,45 @@ def _time_fit_scan(model, x, y, k=64, repeats=5, score=None):
             model.fit_scan(xs, ys)
             host_sync(score())
             ts.append(time.perf_counter() - t0)
-        return statistics.median(ts)
+        return min(ts)
 
     k1 = max(1, k // 8)              # both runs multi-step: the differencing
     x1, y1 = _tile_steps(x, k1), _tile_steps(y, k1)   # baseline is then well
-    t1 = run(x1, y1)                                  # above RPC jitter
-    while True:
-        xk, yk = _tile_steps(x, k), _tile_steps(y, k)
-        tk = run(xk, yk)
-        delta = tk - t1
-        # the delta must clear the host-read RPC jitter (~±5ms here) or the
-        # measurement is noise — grow the scan until it does
-        if delta > 0.02:
+    xk, yk = _tile_steps(x, k), _tile_steps(y, k)     # above RPC jitter
+
+    # Pool contention poisons any single window, and it can poison the two
+    # phases of ONE differencing asymmetrically (a slow t1 window next to a
+    # fast tk window understates sec — even past physically possible MFU).
+    # Interleave t1/tk sampling and difference the GLOBAL minima: each
+    # phase's min converges to its uncontended floor, which removes the
+    # asymmetry. Keep sampling (3..6 pairs) until the estimate stops
+    # improving by more than 10%.
+    t1s, tks = [], []
+    sec = None
+    pairs = 0
+    while pairs < 6:
+        t1s.append(run(x1, y1))
+        tks.append(run(xk, yk))
+        pairs += 1
+        delta = min(tks) - min(t1s)
+        if delta <= 0.02:
+            # inside host-read RPC jitter — grow the scan and restart
+            if k >= 1024:
+                raise RuntimeError(
+                    f"unmeasurable: {k}-step delta {delta * 1e3:.1f}ms is "
+                    "inside host-read RPC jitter")
+            k *= 4
+            xk, yk = _tile_steps(x, k), _tile_steps(y, k)
+            t1s, tks = [], []
+            pairs = 0
+            sec = None
+            continue
+        cand = delta / (k - k1)
+        if sec is not None and pairs >= 3 and \
+                abs(cand - sec) / max(min(cand, sec), 1e-12) < 0.10:
+            sec = cand
             break
-        if k >= 1024:
-            raise RuntimeError(
-                f"unmeasurable: {k}-step delta {delta * 1e3:.1f}ms is inside "
-                "host-read RPC jitter")
-        k *= 4
-    sec = delta / (k - k1)
+        sec = cand
     flops = None
     try:
         import jax.numpy as jnp
@@ -264,13 +286,14 @@ def bench_charrnn(batch=32, seq_len=64, vocab=77, big_batch=256):
         sec, flops = _time_fit_scan(net, xy[0], xy[1], k=k)
         return sec, flops
 
-    ops.set_helpers_enabled(True)      # fused Pallas kernel
+    ops.set_helpers_enabled(True)      # fused Pallas kernel(s)
     sec_fused, flops = measure()
     sec_bf16, flops_bf16 = measure("bfloat16")
     xb, yb = make_batch(big_batch)
     sec_big, flops_big = measure("bfloat16", (xb, yb), k=32)
     ops.set_helpers_enabled(False)     # pure lax.scan path
     sec_scan, _ = measure()
+    sec_scan_big, _ = measure("bfloat16", (xb, yb), k=32)
     ops.set_helpers_enabled(None)
 
     _emit(
@@ -280,7 +303,9 @@ def bench_charrnn(batch=32, seq_len=64, vocab=77, big_batch=256):
     _emit(
         f"charRNN-LSTM train (batch={big_batch}, T={seq_len}, fused kernel, "
         "bf16)", big_batch * seq_len / sec_big, "chars/sec", BARS["charrnn"],
-        {"mfu": _mfu(flops_big, 1.0 / sec_big), "compute_dtype": "bf16"})
+        {"mfu": _mfu(flops_big, 1.0 / sec_big), "compute_dtype": "bf16",
+         "fused_vs_scan_speedup": round(sec_scan_big / sec_big, 3),
+         "scan_chars_per_sec": round(big_batch * seq_len / sec_scan_big, 1)})
     cps = batch * seq_len / sec_fused
     return _emit(
         f"charRNN-LSTM train (batch={batch}, T={seq_len}, fused kernel)",

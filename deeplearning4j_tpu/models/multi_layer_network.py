@@ -105,9 +105,23 @@ class MultiLayerNetwork:
         n = len(self.layers) if upto is None else upto
         new_states = list(state)
         new_carries = list(carries) if carries is not None else None
-        for i in range(n):
+        i = 0
+        while i < n:
             l = self.layers[i]
             lrng = None if rng is None else jax.random.fold_in(rng, i)
+            # consecutive stacked LSTMs fuse into ONE wavefront kernel (the
+            # cuDNN numLayers=2 schedule — see ops/lstm_pallas.py); the
+            # stateful-carry path (rnn_time_step) stays per-layer
+            if (new_carries is None and i + 1 < n and x.ndim == 3):
+                from deeplearning4j_tpu.nn.layers.rnn import (
+                    lstm_pair_fusable, apply_lstm_pair)
+                if lstm_pair_fusable(l, self.layers[i + 1], params[i],
+                                     params[i + 1], x, mask):
+                    x = apply_lstm_pair(l, self.layers[i + 1],
+                                        params[i], params[i + 1], x,
+                                        train=train, rng=lrng)
+                    i += 2
+                    continue
             p_i = params[i]
             if train and l.weight_noise is not None and lrng is not None:
                 p_i = l.weight_noise.apply(
@@ -121,6 +135,7 @@ class MultiLayerNetwork:
                 new_states[i] = st if st is not None else state[i]
             if x.ndim == 2:
                 mask = None  # sequence collapsed to per-example
+            i += 1
         if gc.compute_dtype:
             # keep persistent layer state (e.g. BN running stats) at its
             # storage dtype so dtypes are stable across steps

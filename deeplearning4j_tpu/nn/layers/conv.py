@@ -486,7 +486,11 @@ class BatchNormalization(Layer):
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
         else:
-            mean, var = state["mean"], state["var"]
+            # running stats are stored f32 (dtype-stable state contract);
+            # cast to the activation dtype or a bf16 forward would promote
+            # to f32 and crash the next conv on mixed dtypes
+            mean = state["mean"].astype(x.dtype)
+            var = state["var"].astype(x.dtype)
             new_state = state
         xn = (x - mean) * lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:

@@ -155,6 +155,53 @@ class LSTM(Layer):
         return y, new_carry
 
 
+def lstm_pair_fusable(l1, l2, p1, p2, x, mask):
+    """True when two consecutive LSTM layers can run as ONE wavefront
+    stacked kernel (ops.fused_lstm2_sequence — the cuDNN numLayers=2
+    fused-RNN schedule). Each layer must pass its OWN fused-support
+    envelope (``_fused_supported`` — so future envelope changes apply here
+    automatically) with the true promoted dtype; the pair additionally
+    needs equal hidden sizes (the wavefront batches h1 @ [RW1|W2]), no
+    inter-layer dropout/weight-noise (they would need an elementwise op
+    between the layers), and the stacked kernel's own VMEM screen."""
+    from deeplearning4j_tpu import ops
+    from deeplearning4j_tpu.ops.lstm_pallas import (supported2,
+                                                    use_pallas_fwd)
+    if not (type(l1) is LSTM and type(l2) is LSTM
+            and l1.n_out == l2.n_out and l2.n_in == l1.n_out
+            and not l2.dropout       # None or 0.0; IDropout objects block
+            and l1.weight_noise is None and l2.weight_noise is None):
+        return False
+    B, T = x.shape[0], x.shape[1]
+    # the dtype apply_lstm_pair will actually promote with (same rule as
+    # LSTM.apply's carry dtype — f64 gradient checks must fall back)
+    dt = jnp.result_type(x.dtype, p1["W"].dtype, p2["W"].dtype)
+    if not (l1._fused_supported(mask, B, T, dt)
+            and l2._fused_supported(mask, B, T, dt)):
+        return False
+    interp = ops.interpret_mode()
+    return supported2(B, T, l1.n_out, jnp.dtype(dt).itemsize, interp) and \
+        (interp or use_pallas_fwd(B, l1.n_out))
+
+
+def apply_lstm_pair(l1, l2, p1, p2, x, *, train, rng):
+    """Run two fusable stacked LSTMs through the wavefront kernel.
+    Layer-1 dropout applies to x (its own semantics); returns the layer-2
+    hidden sequence (B, T, H)."""
+    from deeplearning4j_tpu import ops
+    x = l1.maybe_dropout(x, train=train, rng=rng)
+    B, T, _ = x.shape
+    dt = jnp.result_type(x.dtype, p1["W"].dtype, p2["W"].dtype)
+    gate_in1 = (x.reshape(B * T, -1) @ p1["W"] + p1["b"])
+    gate_in1 = gate_in1.reshape(B, T, -1).transpose(1, 0, 2).astype(dt)
+    z = jnp.zeros((B, l1.n_out), dt)
+    hs2, _, _, _ = ops.fused_lstm2_sequence(
+        gate_in1, p1["RW"].astype(dt), p2["W"].astype(dt),
+        p2["b"].astype(dt), p2["RW"].astype(dt), z, z, z, z,
+        ops.interpret_mode())
+    return hs2.transpose(1, 0, 2)
+
+
 @register_layer
 @dataclass
 class GravesLSTM(LSTM):

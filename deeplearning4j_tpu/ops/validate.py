@@ -156,6 +156,80 @@ def validate_lstm_case(b, t, h, dtype="float32", rtol=2e-3, atol=2e-4,
     return res
 
 
+# ------------------------------------------------------ stacked LSTM sweep
+
+def _lstm2_scan_reference(gate_in1, rw1, w2, b2, rw2, h01, c01, h02, c02):
+    """Two sequential scan layers on the stacked op's contract."""
+    hs1, _ = _lstm_scan_reference(gate_in1, rw1, h01, c01)
+    T, B, _ = hs1.shape
+    gi2 = (hs1.reshape(T * B, -1) @ w2 + b2).reshape(T, B, -1)
+    hs2, c2T = _lstm_scan_reference(gi2, rw2, h02, c02)
+    return hs2, c2T
+
+
+def validate_lstm2_case(b, t, h, dtype="float32", rtol=2e-3, atol=2e-4,
+                        time_it=True):
+    """Stacked wavefront kernel vs two sequential scan layers: layer-2
+    outputs and every gradient (incl. layer-2 weights, which only the
+    stacked op owns)."""
+    from deeplearning4j_tpu.ops.lstm_pallas import (fused_lstm2_sequence,
+                                                    supported2)
+    dt = jnp.dtype(dtype)
+    assert supported2(b, t, h, dt.itemsize), (b, t, h, dtype)
+    if dt == jnp.bfloat16:
+        rtol, atol = rtol * 16, atol * 16
+    rs = np.random.RandomState(h + b + t + 1)
+    gi = jnp.asarray(rs.randn(t, b, 4 * h) * 0.4, dt)
+    rw1 = jnp.asarray(rs.randn(h, 4 * h) / np.sqrt(h), dt)
+    w2 = jnp.asarray(rs.randn(h, 4 * h) / np.sqrt(h), dt)
+    b2 = jnp.asarray(rs.randn(4 * h) * 0.1, dt)
+    rw2 = jnp.asarray(rs.randn(h, 4 * h) / np.sqrt(h), dt)
+    z = jnp.zeros((b, h), dt)
+    cot = jnp.asarray(rs.randn(t, b, h), jnp.float32)
+
+    def loss_fused(gi, rw1, w2, b2, rw2):
+        hs2, _, _, _ = fused_lstm2_sequence(gi, rw1, w2, b2, rw2,
+                                            z, z, z, z)
+        return jnp.sum(hs2.astype(jnp.float32) * cot)
+
+    def loss_ref(gi, rw1, w2, b2, rw2):
+        hs2, _ = _lstm2_scan_reference(gi, rw1, w2, b2, rw2, z, z, z, z)
+        return jnp.sum(hs2.astype(jnp.float32) * cot)
+
+    f_fused = jax.jit(lambda *a: fused_lstm2_sequence(*a, z, z, z, z)[0])
+    f_ref = jax.jit(lambda *a: _lstm2_scan_reference(*a, z, z, z, z)[0])
+    g_fused = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4)))
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4)))
+
+    args = (gi, rw1, w2, b2, rw2)
+    errs = {"hs2": _max_err(f_fused(*args), f_ref(*args))}
+    for name, a, b_ in zip(("dgi", "drw1", "dw2", "db2", "drw2"),
+                           g_fused(*args), g_ref(*args)):
+        errs[name] = _max_err(a, b_)
+        scale = float(jnp.max(jnp.abs(b_).astype(jnp.float32))) + 1.0
+        assert errs[name] <= atol + rtol * scale, \
+            f"LSTM2 B={b} T={t} H={h} {dtype}: {name} err {errs[name]}"
+    assert errs["hs2"] <= atol + rtol * 2, errs
+
+    res = {"kernel": "fused_lstm2", "B": b, "T": t, "H": h, "dtype": dtype,
+           "max_err": round(max(errs.values()), 8)}
+    if time_it:
+        tf = _time(f_fused, *args)
+        tr = _time(f_ref, *args)
+        tgf = _time(g_fused, *args)
+        tgr = _time(g_ref, *args)
+        res.update(fwd_us=round(tf * 1e6, 1), fwd_scan_us=round(tr * 1e6, 1),
+                   fwd_speedup=_speedup(tr, tf),
+                   grad_us=round(tgf * 1e6, 1),
+                   grad_scan_us=round(tgr * 1e6, 1),
+                   grad_speedup=_speedup(tgr, tgf))
+    return res
+
+
+LSTM2_SWEEP = [(32, 64, 256), (64, 64, 128), (128, 32, 256), (256, 64, 256)]
+LSTM2_QUICK = [(32, 64, 256)]
+
+
 # ----------------------------------------------------------- attention sweep
 
 def validate_attention_case(bh, t, dh, causal, rtol=1e-2, atol=1e-3,
@@ -228,6 +302,17 @@ def run(quick=False, time_it=True):
                 print(json.dumps(r))
             except Exception as e:  # noqa: BLE001 — report every failing shape
                 failures.append({"kernel": "fused_lstm", "B": b, "T": t,
+                                 "H": h, "dtype": dtype,
+                                 "error": f"{type(e).__name__}: {e}"[:300]})
+                print(json.dumps(failures[-1]))
+    for b, t, h in (LSTM2_QUICK if quick else LSTM2_SWEEP):
+        for dtype in ("float32", "bfloat16"):
+            try:
+                r = validate_lstm2_case(b, t, h, dtype, time_it=time_it)
+                results.append(r)
+                print(json.dumps(r))
+            except Exception as e:  # noqa: BLE001
+                failures.append({"kernel": "fused_lstm2", "B": b, "T": t,
                                  "H": h, "dtype": dtype,
                                  "error": f"{type(e).__name__}: {e}"[:300]})
                 print(json.dumps(failures[-1]))

@@ -140,3 +140,87 @@ class TestFlashAttention:
         y_ref, _ = lyr.apply(params, x)
         np.testing.assert_allclose(np.asarray(y_fa), np.asarray(y_ref),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestFusedStackedLSTM:
+    """Wavefront 2-layer kernel (ops.fused_lstm2_sequence) must equal two
+    sequential fused/scan layers — outputs and every gradient."""
+
+    def _net_2lstm(self, vocab=6, H=8):
+        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers.rnn import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        conf = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(LSTM(n_out=H, activation="tanh"))
+                .layer(LSTM(n_out=H, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(vocab))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_pair_matches_sequential_outputs_and_grads(self, helpers_on):
+        from deeplearning4j_tpu.nn.layers.rnn import lstm_pair_fusable
+        net = self._net_2lstm()
+        rs = np.random.RandomState(5)
+        x = jnp.asarray(rs.randn(4, 10, 6), jnp.float32)
+        y = jnp.asarray(np.eye(6, dtype=np.float32)[
+            rs.randint(0, 6, (4, 10))])
+        assert lstm_pair_fusable(net.layers[0], net.layers[1],
+                                 net.params[0], net.params[1], x, None)
+
+        def loss(p, x):
+            l, _ = net._loss(p, net.state, x, y, None, None, None)
+            return l
+
+        # fused pair (helpers on, interpret)
+        l_pair = float(loss(net.params, x))
+        g_pair = jax.grad(loss, argnums=(0, 1))(net.params, x)
+        # sequential reference (helpers off -> pure scan layers)
+        ops.set_helpers_enabled(False)
+        l_seq = float(loss(net.params, x))
+        g_seq = jax.grad(loss, argnums=(0, 1))(net.params, x)
+        ops.set_helpers_enabled(True, interpret=True)
+
+        assert abs(l_pair - l_seq) < 1e-5, (l_pair, l_seq)
+        np.testing.assert_allclose(np.asarray(g_pair[1]),
+                                   np.asarray(g_seq[1]),
+                                   rtol=1e-4, atol=1e-5, err_msg="dx")
+        for li, (pp, ps) in enumerate(zip(g_pair[0], g_seq[0])):
+            for k in ps:
+                np.testing.assert_allclose(
+                    np.asarray(pp[k]), np.asarray(ps[k]),
+                    rtol=1e-4, atol=1e-5, err_msg=f"layer{li}/{k}")
+
+    def test_pair_inference_matches_sequential(self, helpers_on):
+        net = self._net_2lstm()
+        rs = np.random.RandomState(7)
+        x = np.asarray(rs.randn(3, 8, 6), np.float32)
+        out_pair = np.asarray(net.output(x))
+        ops.set_helpers_enabled(False)
+        net._output_fn = None
+        out_seq = np.asarray(net.output(x))
+        ops.set_helpers_enabled(True, interpret=True)
+        net._output_fn = None
+        np.testing.assert_allclose(out_pair, out_seq, rtol=1e-5, atol=1e-5)
+
+    def test_pair_not_fused_with_dropout_between(self, helpers_on):
+        """Inter-layer dropout blocks fusion (falls back, still correct)."""
+        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers.rnn import (LSTM, RnnOutputLayer,
+                                                      lstm_pair_fusable)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        conf = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(LSTM(n_out=8))
+                .layer(LSTM(n_out=8, dropout=0.5))
+                .layer(RnnOutputLayer(n_out=6, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = jnp.ones((2, 4, 6), jnp.float32)
+        assert not lstm_pair_fusable(net.layers[0], net.layers[1],
+                                     net.params[0], net.params[1], x, None)
+        y = np.eye(6, dtype=np.float32)[np.zeros((2, 4), int)]
+        net.fit(np.asarray(x), y)
+        assert np.isfinite(net.get_score())

@@ -152,3 +152,17 @@ def test_pretrained_checksum_verification(tmp_path, monkeypatch):
     p.write_bytes(p.read_bytes()[:-7] + b"garbage")   # corrupt the cache
     with pytest.raises(IOError, match="Checksum mismatch"):
         model.init_pretrained()
+
+
+def test_zoo_bf16_inference_output():
+    """compute_dtype='bfloat16' must work for INFERENCE too: eval-mode BN
+    normalizes with f32 running stats against bf16 activations (was: mixed
+    dtype promotion crashed the following conv)."""
+    import numpy as np
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+    cg = ResNet50(num_classes=10, input_shape=(32, 32, 3), seed=7,
+                  compute_dtype="bfloat16").init()
+    x = np.random.RandomState(0).rand(4, 32, 32, 3).astype(np.float32)
+    out = np.asarray(cg.output(x))
+    assert out.shape == (4, 10)
+    assert np.all(np.isfinite(out))
