@@ -286,15 +286,19 @@ def bench_charrnn(batch=32, seq_len=64, vocab=77, big_batch=256):
         sec, flops = _time_fit_scan(net, xy[0], xy[1], k=k)
         return sec, flops
 
-    ops.set_helpers_enabled(True)      # fused Pallas kernel(s)
-    sec_fused, flops = measure()
-    sec_bf16, flops_bf16 = measure("bfloat16")
-    xb, yb = make_batch(big_batch)
-    sec_big, flops_big = measure("bfloat16", (xb, yb), k=32)
-    ops.set_helpers_enabled(False)     # pure lax.scan path
-    sec_scan, _ = measure()
-    sec_scan_big, _ = measure("bfloat16", (xb, yb), k=32)
-    ops.set_helpers_enabled(None)
+    try:
+        ops.set_helpers_enabled(True)      # fused Pallas kernel(s)
+        sec_fused, flops = measure()
+        sec_bf16, flops_bf16 = measure("bfloat16")
+        xb, yb = make_batch(big_batch)
+        sec_big, flops_big = measure("bfloat16", (xb, yb), k=32)
+        ops.set_helpers_enabled(False)     # pure lax.scan path
+        sec_scan, _ = measure()
+        sec_scan_big, _ = measure("bfloat16", (xb, yb), k=32)
+    finally:
+        # a failed measurement must not leave the global helper override
+        # set, silently changing every later bench's kernel configuration
+        ops.set_helpers_enabled(None)
 
     _emit(
         f"charRNN-LSTM train (batch={batch}, T={seq_len}, fused kernel, "
@@ -531,15 +535,17 @@ class ListDataSetIteratorLazy:
         return DataSet(self.x[s], self.y[s])
 
 
+# ordered by importance: if the harness cuts the run short, the rows that
+# matter most (the BASELINE.md headline configs) are already recorded
 BENCHES = {
-    "lenet": bench_lenet,
-    "accuracy": bench_accuracy,
-    "resnet50": bench_resnet50,
     "resnet50_imagenet": bench_resnet50_imagenet,
-    "vgg16": bench_vgg16,
     "charrnn": bench_charrnn,
+    "resnet50": bench_resnet50,
+    "lenet": bench_lenet,
+    "vgg16": bench_vgg16,
     "parallelwrapper": bench_parallel_wrapper,
     "word2vec": bench_word2vec,
+    "accuracy": bench_accuracy,
 }
 
 
@@ -553,6 +559,25 @@ def main(argv=None):
     names = a.only or list(BENCHES)
     failures = 0
     errors = []
+
+    # compact one-line summary of every metric so far: m=metric
+    # (abbreviated), v=value, x=vs_baseline, f=mfu. Printed after EVERY
+    # bench (not only at the end) so a bounded tail capture — the driver
+    # keeps ~2000 bytes, and may kill a long run mid-flight — always holds
+    # a complete record of everything measured up to that point.
+    def _abbr(m):
+        return (m.replace(" train", "").replace(", 1 chip", "")
+                 .replace(", fit_scan", "").replace("batch=", "b")
+                 .replace("devices=", "d").replace(" ", ""))
+
+    def print_summary():
+        summary = [{k: v for k, v in
+                    (("m", _abbr(l["metric"])), ("v", l["value"]),
+                     ("x", l["vs_baseline"]), ("f", l.get("mfu")))
+                    if v is not None} for l in _EMITTED]
+        print(json.dumps({"summary": summary, "errors": errors},
+                         separators=(",", ":")), flush=True)
+
     for name in names:
         try:
             BENCHES[name]()
@@ -562,19 +587,7 @@ def main(argv=None):
             print(json.dumps({"metric": name, "error":
                               f"{type(e).__name__}: {e}"[:300]}),
                   file=sys.stderr, flush=True)
-    # final compact one-line summary of EVERY metric, printed last so a
-    # bounded tail capture (the driver keeps ~2000 bytes) still records the
-    # whole round: m=metric (abbreviated), v=value, x=vs_baseline, f=mfu
-    def _abbr(m):
-        return (m.replace(" train", "").replace(", 1 chip", "")
-                 .replace(", fit_scan", "").replace("batch=", "b")
-                 .replace("devices=", "d").replace(" ", ""))
-    summary = [{k: v for k, v in
-                (("m", _abbr(l["metric"])), ("v", l["value"]),
-                 ("x", l["vs_baseline"]), ("f", l.get("mfu")))
-                if v is not None} for l in _EMITTED]
-    print(json.dumps({"summary": summary, "errors": errors},
-                     separators=(",", ":")), flush=True)
+        print_summary()
     return 1 if failures else 0
 
 

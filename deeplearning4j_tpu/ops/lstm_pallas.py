@@ -79,13 +79,16 @@ def _resident_bytes(b, h, itemsize):
     return h * 4 * h * itemsize + 8 * b * h * 4
 
 
-def _pick_k(t, b, h, itemsize, elems_h):
+def _pick_k(t, b, h, itemsize, elems_h, resident=None):
     """Largest K dividing T whose double-buffered stream blocks plus the
-    resident RW/scratch fit the VMEM budget. Sizing from the TOTAL per-grid-
-    step footprint (all blocked operands x2 for double buffering) — not just
-    one stream — is what keeps Mosaic from oversubscribing VMEM at large
-    B*H (the round-3 failure mode)."""
-    resident = _resident_bytes(b, h, itemsize)
+    resident weight/scratch blocks fit the VMEM budget. Sizing from the
+    TOTAL per-grid-step footprint (all blocked operands x2 for double
+    buffering) — not just one stream — is what keeps Mosaic from
+    oversubscribing VMEM at large B*H (the round-3 failure mode).
+    ``resident`` overrides the single-layer weight/scratch footprint (the
+    stacked kernel holds a 3x-wider weight block and twice the carries)."""
+    if resident is None:
+        resident = _resident_bytes(b, h, itemsize)
     # Prefer K=2: the sequentially-executed grid double-buffers the next
     # block behind the current one, so SMALL blocks overlap loads/stores
     # with compute best — measured on v5e at (256,64,256): K=2 144us,
@@ -439,10 +442,15 @@ def supported2(b, t, h, itemsize=4, interpret=False):
     (the backward reuses them) plus the wavefront forward at K=1."""
     if interpret:
         return True
-    resident2 = h * 12 * h * itemsize + 10 * b * h * 4   # [RW1|W2|RW2]
     return (supported(b, t, h, itemsize)
-            and 2 * b * _ELEMS2_TRAIN * h * itemsize + resident2
-            <= _VMEM_BUDGET)
+            and 2 * b * _ELEMS2_TRAIN * h * itemsize
+            + _resident2_bytes(b, h, itemsize) <= _VMEM_BUDGET)
+
+
+def _resident2_bytes(b, h, itemsize):
+    """Stacked-kernel resident VMEM: the [RW1|W2|RW2] (H,12H) block plus
+    doubled carries/scratch."""
+    return h * 12 * h * itemsize + 10 * b * h * 4
 
 
 def _fwd2_kernel(K, save_reserve, gate_in_ref, rww_ref, b2_ref,
@@ -519,7 +527,8 @@ def _fwd2_call(gate_in1, rww, b2, h01, c01, h02, c02, *, interpret,
     dt = gate_in1.dtype
     isz = jnp.dtype(dt).itemsize
     K = _pick_k(T, B, H, isz,
-                _ELEMS2_TRAIN if save_reserve else _ELEMS2_INFER)
+                _ELEMS2_TRAIN if save_reserve else _ELEMS2_INFER,
+                resident=_resident2_bytes(B, H, isz))
     step_b = lambda t: (t, 0, 0)
     fixed2 = lambda t: (0, 0)
     state_spec = pl.BlockSpec((K, B, H), step_b, memory_space=pltpu.VMEM)

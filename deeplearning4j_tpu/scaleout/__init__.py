@@ -19,6 +19,10 @@ from deeplearning4j_tpu.scaleout.training_master import (
     SharedTrainingMaster,
     TrainingStats,
 )
+from deeplearning4j_tpu.scaleout.ml_pipeline import (
+    NetworkClassifier, NetworkModel, AutoEncoderEstimator, AutoEncoderModel,
+    Pipeline,
+)
 from deeplearning4j_tpu.scaleout.cluster import (
     ClusterMultiLayerNetwork,
     ClusterComputationGraph,
@@ -26,6 +30,8 @@ from deeplearning4j_tpu.scaleout.cluster import (
 )
 
 __all__ = [
+    "NetworkClassifier", "NetworkModel", "AutoEncoderEstimator",
+    "AutoEncoderModel", "Pipeline",
     "TrainingMaster", "ParameterAveragingTrainingMaster",
     "SharedTrainingMaster", "TrainingStats",
     "ClusterMultiLayerNetwork", "ClusterComputationGraph", "repartition",

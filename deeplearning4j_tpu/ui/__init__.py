@@ -21,10 +21,11 @@ from deeplearning4j_tpu.ui.storage import (
 from deeplearning4j_tpu.ui.stats_listener import StatsListener
 from deeplearning4j_tpu.ui.conv_listener import ConvolutionalIterationListener
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.remote import WebReporter
 from deeplearning4j_tpu.ui import components
 
 __all__ = [
     "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
-    "RemoteUIStatsStorageRouter", "StatsReport", "StatsListener",
+    "RemoteUIStatsStorageRouter", "WebReporter", "StatsReport", "StatsListener",
     "ConvolutionalIterationListener", "UIServer", "components",
 ]

@@ -1,0 +1,105 @@
+"""Async remote stats transport: train in one process, watch the UI in
+another, without ever blocking the train loop on the network.
+
+Parity surface: deeplearning4j-ui-remote-iterationlisteners —
+``WebReporter.java`` (a background thread draining a queue of UI POSTs so
+"network processing should be handled in background, without slowing
+caller thread") and ``RemoteConvolutionalIterationListener.java`` (the
+conv-activations listener pointed at a remote UI). The receiving half is
+``ui/server.py`` POST /remote (RemoteReceiverModule parity); the wire
+format lives in ONE place — the synchronous ``RemoteUIStatsStorageRouter``
+(ui/storage.py), which this class wraps with a queue + worker thread.
+
+TPU-native composition instead of listener forks: every UI listener here
+already writes through the StatsStorage interface, so ONE async
+storage-shaped transport makes ALL of them remote —
+
+    reporter = WebReporter("http://ui-host:9000")
+    net.add_listeners(StatsListener(reporter, frequency=10),
+                      ConvolutionalIterationListener(reporter))
+
+is the remote version of the same listeners against a local storage (the
+reference needed a separate RemoteConvolutionalIterationListener class for
+this; here it falls out of the seam).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from deeplearning4j_tpu.ui.storage import RemoteUIStatsStorageRouter
+
+
+class WebReporter:
+    """StatsStorage-shaped async wrapper around RemoteUIStatsStorageRouter.
+
+    Deliveries drain on a background thread through a bounded queue
+    (WebReporter.java semantics): a slow or down collector never stalls
+    training; on overflow or exhausted retries, records are counted in
+    ``dropped`` instead of blocking."""
+
+    def __init__(self, base_url: str, queue_size: int = 256,
+                 retries: int = 3, timeout: float = 2.0):
+        self._router = RemoteUIStatsStorageRouter(base_url, timeout=timeout)
+        self.retries = retries
+        self.dropped = 0
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._pending = 0                    # enqueued but not yet settled
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    # ---------------------------------------------- StatsStorage interface
+    def put_static_info(self, session_id: str, info: dict):
+        self._enqueue(("put_static_info", (session_id, info)))
+
+    def put_update(self, report):
+        self._enqueue(("put_update", (report,)))
+
+    # ------------------------------------------------------------ plumbing
+    def _enqueue(self, item):
+        with self._lock:
+            try:
+                self._q.put_nowait(item)
+                self._pending += 1
+            except queue.Full:
+                self.dropped += 1    # never stall the training loop
+
+    def _drain(self):
+        while not self._closed.is_set():
+            try:
+                method, args = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            ok = False
+            for _ in range(self.retries):
+                try:
+                    getattr(self._router, method)(*args)
+                    ok = True
+                    break
+                except Exception:
+                    if self._closed.is_set():
+                        break
+            with self._lock:
+                self._pending -= 1
+                if not ok:
+                    self.dropped += 1
+
+    def flush(self, timeout: float = 10.0):
+        """Block until every enqueued record is SETTLED (delivered or given
+        up after retries) — not merely dequeued; a single in-flight record
+        may spend up to retries*timeout in delivery attempts."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if self._pending == 0:
+                    return
+            time.sleep(0.02)
+
+    def close(self):
+        self.flush()
+        self._closed.set()
+        self._worker.join(timeout=2.0)

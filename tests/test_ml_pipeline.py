@@ -1,0 +1,90 @@
+"""ML-pipeline facade tests (parity role: dl4j-spark-ml SparkDl4jNetwork /
+AutoEncoder estimator tests — see scaleout/ml_pipeline.py)."""
+
+import numpy as np
+
+from deeplearning4j_tpu import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.scaleout import (NetworkClassifier,
+                                         AutoEncoderEstimator, Pipeline,
+                                         NetworkModel)
+
+
+def _clf_conf():
+    return (NeuralNetConfiguration.builder().seed(7).list()
+            .layer(DenseLayer(n_in=8, n_out=24, activation="relu"))
+            .layer(OutputLayer(n_in=24, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+
+
+def _blobs(n=240, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(3, 8) * 3
+    y = rs.randint(0, 3, n)
+    X = centers[y] + rs.randn(n, 8) * 0.5
+    return X.astype(np.float32), y
+
+
+def test_classifier_fit_predict_score():
+    X, y = _blobs()
+    clf = NetworkClassifier(_clf_conf, epochs=20, batch_size=32)
+    model = clf.fit(X, y)
+    assert model.score(X, y) > 0.9
+    proba = model.predict_proba(X[:5])
+    assert proba.shape == (5, 3)
+    np.testing.assert_allclose(proba.sum(-1), 1.0, atol=1e-4)
+    # estimator delegates after fit (sklearn-style)
+    assert clf.score(X, y) == model.score(X, y)
+
+
+def test_classifier_sklearn_protocol_and_save_load(tmp_path):
+    X, y = _blobs(120, seed=3)
+    clf = NetworkClassifier(_clf_conf, epochs=5)
+    assert clf.get_params()["epochs"] == 5
+    clf.set_params(epochs=15, batch_size=64)
+    model = clf.fit(X, y)
+    p = str(tmp_path / "clf.zip")
+    model.save(p)
+    loaded = NetworkModel.load(p)
+    np.testing.assert_allclose(loaded.predict_proba(X[:8]),
+                               model.predict_proba(X[:8]), atol=1e-6)
+
+
+def test_autoencoder_transform_shape_and_pipeline():
+    def ae_conf():
+        return (NeuralNetConfiguration.builder().seed(5).list()
+                .layer(DenseLayer(n_in=8, n_out=3, activation="tanh"))
+                .layer(OutputLayer(n_in=3, n_out=8, activation="identity",
+                                   loss="mse"))
+                .build())
+
+    X, y = _blobs(160, seed=5)
+    ae = AutoEncoderEstimator(ae_conf, compressed_layer=0, epochs=10)
+    enc = ae.fit(X).transform(X)
+    assert enc.shape == (160, 3)
+
+    def clf_conf():
+        return (NeuralNetConfiguration.builder().seed(7).list()
+                .layer(DenseLayer(n_in=3, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+
+    pipe = Pipeline([
+        ("encode", AutoEncoderEstimator(ae_conf, compressed_layer=0,
+                                        epochs=10)),
+        ("classify", NetworkClassifier(clf_conf, epochs=25, batch_size=32)),
+    ])
+    pipe.fit(X, y)
+    assert pipe.predict(X).shape == (160,)
+    assert pipe.score(X, y) > 0.6
+
+
+def test_classifier_on_mesh():
+    """workers= routes training through ParallelWrapper (TrainingMaster
+    role) over the virtual device mesh."""
+    X, y = _blobs(192, seed=9)
+    clf = NetworkClassifier(_clf_conf, epochs=40, batch_size=48, workers=8)
+    model = clf.fit(X, y)
+    assert model.score(X, y) > 0.85

@@ -237,3 +237,64 @@ class TestModelSystemActivationPages:
             assert png[:8] == b"\x89PNG\r\n\x1a\n"
         finally:
             ui.stop()
+
+
+def test_webreporter_async_remote_training():
+    """WebReporter (async queue, WebReporter.java parity): a real training
+    run with StatsListener pointed at a remote UI server delivers static
+    info + per-iteration updates without blocking the train loop."""
+    import numpy as np
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.ui import UIServer, StatsListener, WebReporter
+
+    ui = UIServer(port=0)
+    try:
+        remote_storage = ui.enable_remote_listener()
+        reporter = WebReporter(f"http://127.0.0.1:{ui.port}")
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.add_listeners(StatsListener(reporter, frequency=1,
+                                        session_id="ws"))
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+        for _ in range(4):
+            net.fit(x, y)
+        reporter.flush()
+        ups = remote_storage.get_all_updates("ws")
+        assert len(ups) >= 3
+        assert remote_storage.get_static_info("ws")["numLayers"] == 2
+        assert reporter.dropped == 0
+        reporter.close()
+    finally:
+        ui.stop()
+
+
+def test_webreporter_down_collector_never_blocks():
+    """A dead collector must not stall training: records drop, fit runs."""
+    import time
+    import numpy as np
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.ui import StatsListener, WebReporter
+
+    reporter = WebReporter("http://127.0.0.1:9", retries=1, timeout=0.1)
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.add_listeners(StatsListener(reporter, frequency=1))
+    x = np.random.rand(16, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.randint(0, 2, 16)]
+    t0 = time.perf_counter()
+    for _ in range(3):
+        net.fit(x, y)
+    assert time.perf_counter() - t0 < 30     # no per-iteration stalls
+    reporter.close()
