@@ -28,9 +28,8 @@ def _one_hot(y, n):
     y = np.asarray(y)
     if y.ndim == 2:          # already one-hot
         return y.astype(np.float32)
-    out = np.zeros((len(y), n), np.float32)
-    out[np.arange(len(y)), y.astype(int)] = 1.0
-    return out
+    from deeplearning4j_tpu.data.fetchers import _one_hot as _encode
+    return _encode(y.astype(int), n)
 
 
 class _BaseEstimator:
@@ -141,14 +140,17 @@ class AutoEncoderEstimator(_BaseEstimator):
     COMPRESSED layer's activations, AutoEncoderModel.udfTransformer)."""
 
     _param_names = ("conf_factory", "compressed_layer", "epochs",
-                    "batch_size")
+                    "batch_size", "workers", "mesh")
 
     def __init__(self, conf_factory: Callable, compressed_layer: int,
-                 epochs: int = 1, batch_size: int = 128):
+                 epochs: int = 1, batch_size: int = 128,
+                 workers: Optional[int] = None, mesh=None):
         self.conf_factory = conf_factory
         self.compressed_layer = compressed_layer
         self.epochs = epochs
         self.batch_size = batch_size
+        self.workers = workers
+        self.mesh = mesh
 
     def fit(self, X, y=None):
         from deeplearning4j_tpu.models import MultiLayerNetwork
@@ -159,7 +161,12 @@ class AutoEncoderEstimator(_BaseEstimator):
         net = MultiLayerNetwork(self.conf_factory()).init()
         it = ListDataSetIterator(DataSet(X, X.copy()), self.batch_size,
                                  shuffle=True)
-        net.fit(it, epochs=self.epochs)
+        if self.workers is not None or self.mesh is not None:
+            from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+            ParallelWrapper(net, workers=self.workers,
+                            mesh=self.mesh).fit(it, epochs=self.epochs)
+        else:
+            net.fit(it, epochs=self.epochs)
         self.model_ = AutoEncoderModel(net, self.compressed_layer)
         return self.model_
 

@@ -88,18 +88,35 @@ class WebReporter:
                 if not ok:
                     self.dropped += 1
 
-    def flush(self, timeout: float = 10.0):
+    def flush(self, timeout: float = 10.0) -> bool:
         """Block until every enqueued record is SETTLED (delivered or given
         up after retries) — not merely dequeued; a single in-flight record
-        may spend up to retries*timeout in delivery attempts."""
+        may spend up to retries*timeout in delivery attempts. Returns True
+        when everything settled, False on timeout (records still pending)."""
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._lock:
                 if self._pending == 0:
-                    return
+                    return True
             time.sleep(0.02)
+        return False
 
     def close(self):
+        """Flush, stop the worker, and account for records still QUEUED:
+        they count in ``dropped`` (dropped == 0 after close() means every
+        record was delivered). A record the worker is mid-delivery on is
+        left to the worker's own settle accounting (it may yet succeed) —
+        close() never touches it, so nothing is ever counted twice."""
         self.flush()
         self._closed.set()
         self._worker.join(timeout=2.0)
+        drained = 0
+        while True:
+            try:
+                self._q.get_nowait()
+                drained += 1
+            except queue.Empty:
+                break
+        with self._lock:
+            self._pending -= drained
+            self.dropped += drained
