@@ -51,16 +51,47 @@ def _one_hot(y, n):
 
 
 def _synthetic_images(n, h, w, c, n_classes, seed, template_seed=1234):
-    """Deterministic learnable image data: each class gets a fixed random
-    template (shared across train/test splits via template_seed); samples =
-    template + split-specific noise."""
+    """Deterministic learnable-but-NON-TRIVIAL image data. Class templates
+    share one dominant base pattern; the class-distinctive component is
+    scaled so the Bayes-optimal (matched-filter) error is ~1%, sample noise
+    is Gaussian at comparable energy, and 1% of labels are flipped
+    (deterministically, AFTER the image is drawn from the true class). A
+    correctly trained LeNet therefore lands ~96-99%% — never 100.0 — and a
+    broken updater/optimizer is visible immediately, which makes accuracy
+    rows falsifiable evidence (a saturated 100%% cannot distinguish a
+    working framework from a frozen one)."""
+    sigma = 0.18                      # per-pixel sample-noise std
     trng = np.random.RandomState(template_seed + n_classes * 1000 + h)
-    templates = trng.rand(n_classes, h, w, c).astype(np.float32)
+    shared = (0.35 + 0.3 * trng.rand(h, w, c)).astype(np.float32)
+
+    # Class signal = LOW-FREQUENCY smooth patterns (Gaussian-filtered white
+    # noise, unit L2 norm): spatially structured, so convolution+pooling
+    # architectures learn it at CNN speed — a dense white-noise signature
+    # at the same SNR is destroyed by pooling and trains 100x slower.
+    def smooth(a):
+        r = max(1, h // 8)
+        xs = np.arange(-3 * r, 3 * r + 1)
+        k = np.exp(-0.5 * (xs / r) ** 2)
+        k /= k.sum()
+        for ax in (0, 1):
+            a = np.apply_along_axis(
+                lambda v: np.convolve(v, k, mode="same"), ax, a)
+        return a
+
+    unique = np.stack([smooth(trng.randn(h, w, c)) for _ in
+                       range(n_classes)]).astype(np.float32)
+    unique /= np.sqrt((unique ** 2).sum(axis=(1, 2, 3),
+                                        keepdims=True))          # ||t_c||=1
+    # matched-filter half-gap z = amp*sqrt(2)/(2*sigma); amp tuned so the
+    # union-bound Bayes error (C-1)*Q(z) lands ~1-2% at C=10
+    amp = 3.4 * 2.0 * sigma / np.sqrt(2.0)
+    templates = shared[None] + amp * unique
     rng = np.random.RandomState(seed)
     y = rng.randint(0, n_classes, size=n)
-    noise = rng.rand(n, h, w, c).astype(np.float32) * 0.5
-    x = templates[y] * 0.7 + noise
-    x = np.clip(x, 0.0, 1.0)
+    noise = rng.randn(n, h, w, c).astype(np.float32) * sigma
+    x = np.clip(templates[y] + noise, 0.0, 1.0)
+    flip = rng.rand(n) < 0.01         # deterministic 1% label noise
+    y = np.where(flip, rng.randint(0, n_classes, size=n), y)
     return x, y
 
 

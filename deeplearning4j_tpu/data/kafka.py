@@ -1,0 +1,164 @@
+"""Kafka pub/sub route for NDArray streams (optional-dependency adapter).
+
+Parity surface: dl4j-streaming's Kafka route pair
+(dl4j-streaming/src/main/java/org/deeplearning4j/streaming/kafka/
+NDArrayPubSubRoute.java:8 — NDArrayPublisher + NDArrayConsumer wired through
+Camel). The TPU-native design keeps the broker behind a three-method client
+protocol so the route logic is broker-agnostic and contract-testable without
+a broker: ``InMemoryBroker`` implements the protocol in-process (the test
+double), ``default_client()`` resolves a real ``kafka-python`` client when
+that optional dependency is installed, and the wire format is the same
+base64 NDArray codec the rest of the framework speaks
+(data/streaming.py encode_record/decode_record).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.data.streaming import (
+    StreamingDataSetIterator, encode_record)
+
+
+class BrokerClient:
+    """Minimal broker protocol: durable enough for the route, small enough
+    to fake. Implementations must be thread-safe."""
+
+    def send(self, topic: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def poll(self, topic: str, timeout: float = 0.1) -> List[bytes]:
+        """Return available messages for ``topic`` (possibly empty)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryBroker(BrokerClient):
+    """In-process fake broker: per-topic FIFO queues. Used by the contract
+    tests and by single-process pipelines that want the route shape without
+    a broker deployment."""
+
+    def __init__(self):
+        self._topics: Dict[str, queue.Queue] = defaultdict(queue.Queue)
+        self._lock = threading.Lock()
+
+    def _q(self, topic: str) -> queue.Queue:
+        with self._lock:
+            return self._topics[topic]
+
+    def send(self, topic: str, value: bytes) -> None:
+        self._q(topic).put(bytes(value))
+
+    def poll(self, topic: str, timeout: float = 0.1) -> List[bytes]:
+        q = self._q(topic)
+        out: List[bytes] = []
+        try:
+            out.append(q.get(timeout=timeout))
+            while True:
+                out.append(q.get_nowait())
+        except queue.Empty:
+            pass
+        return out
+
+    def pending(self, topic: str) -> int:
+        """Undelivered message count (approximate, like consumer lag)."""
+        return self._q(topic).qsize()
+
+
+class KafkaPythonClient(BrokerClient):
+    """Adapter over the optional ``kafka-python`` package."""
+
+    def __init__(self, bootstrap_servers: str = "localhost:9092", **kw):
+        import kafka  # optional dependency; ImportError is the gate
+        self._producer = kafka.KafkaProducer(
+            bootstrap_servers=bootstrap_servers, **kw)
+        self._consumers: Dict[str, "kafka.KafkaConsumer"] = {}
+        self._bootstrap = bootstrap_servers
+        self._kw = kw
+
+    def send(self, topic: str, value: bytes) -> None:
+        self._producer.send(topic, value)
+        self._producer.flush()
+
+    def poll(self, topic: str, timeout: float = 0.1) -> List[bytes]:
+        import kafka
+        c = self._consumers.get(topic)
+        if c is None:
+            c = kafka.KafkaConsumer(topic,
+                                    bootstrap_servers=self._bootstrap,
+                                    auto_offset_reset="earliest", **self._kw)
+            self._consumers[topic] = c
+        recs = c.poll(timeout_ms=int(timeout * 1000))
+        return [r.value for batch in recs.values() for r in batch]
+
+    def close(self) -> None:
+        self._producer.close()
+        for c in self._consumers.values():
+            c.close()
+
+
+def default_client(bootstrap_servers: Optional[str] = None) -> BrokerClient:
+    """A real Kafka client when ``kafka-python`` is installed, else a clear
+    error naming the optional dependency (this image is air-gapped)."""
+    try:
+        return KafkaPythonClient(bootstrap_servers or "localhost:9092")
+    except ImportError as e:
+        raise ImportError(
+            "Kafka transport needs the optional 'kafka-python' package "
+            "(pip install kafka-python), or pass any BrokerClient — e.g. "
+            "InMemoryBroker for in-process use.") from e
+
+
+class NDArrayPublisher:
+    """Producer half of the route (parity: NDArrayPublisher)."""
+
+    def __init__(self, client: BrokerClient, topic: str):
+        self.client = client
+        self.topic = topic
+
+    def publish(self, features, labels) -> None:
+        self.client.send(self.topic,
+                         encode_record(features, labels).encode())
+
+
+class NDArrayPubSubRoute:
+    """Consumer half: a background thread polls the topic and pumps decoded
+    records into a StreamingDataSetIterator (parity: the Camel route wiring
+    NDArrayConsumer → training iterator; backpressure comes from the
+    iterator's bounded buffer — when training falls behind, the pump blocks,
+    which is the role consumer lag plays in the reference)."""
+
+    def __init__(self, client: BrokerClient, topic: str, batch_size: int,
+                 buffer_records: int = 1024):
+        self.client = client
+        self.topic = topic
+        self.iterator = StreamingDataSetIterator(
+            batch_size, buffer_records=buffer_records)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NDArrayPubSubRoute":
+        if self._thread is not None:
+            return self
+
+        def pump():
+            while not self._stop.is_set():
+                for msg in self.client.poll(self.topic, timeout=0.1):
+                    self.iterator.push_encoded(msg.decode())
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, end_stream: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if end_stream:
+            self.iterator.end()

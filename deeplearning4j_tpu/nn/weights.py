@@ -54,7 +54,9 @@ def init_weights(rng, shape, scheme="xavier", distribution=None, dtype=jnp.float
     if scheme == "lecun_normal":
         return jax.random.normal(rng, shape, dtype) * jnp.sqrt(1.0 / fi)
     if scheme == "lecun_uniform":
-        b = jnp.sqrt(3.0 / fi)
+        # reference WeightInitUtil.java:88: U[-b,b], b = 3/sqrt(fanIn)
+        # (NOT Keras's sqrt(3/fanIn) — parity follows the reference code)
+        b = 3.0 / jnp.sqrt(fi)
         return jax.random.uniform(rng, shape, dtype, -b, b)
     if scheme == "uniform":
         a = jnp.sqrt(1.0 / fi)
@@ -67,7 +69,11 @@ def init_weights(rng, shape, scheme="xavier", distribution=None, dtype=jnp.float
     if scheme == "xavier_fan_in":
         return jax.random.normal(rng, shape, dtype) / jnp.sqrt(fi)
     if scheme == "xavier_legacy":
-        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(shape[0] * shape[-1])
+        # reference WeightInitUtil.java:106: randn / sqrt(shape[0]+shape[1])
+        # — in its OIHW layout those are the out/in CHANNEL dims, so for
+        # our HWIO kernels the equivalent dims are the trailing two
+        return jax.random.normal(rng, shape, dtype) / jnp.sqrt(
+            shape[-2] + shape[-1])
     if scheme == "relu":
         return jax.random.normal(rng, shape, dtype) * jnp.sqrt(2.0 / fi)
     if scheme == "relu_uniform":
@@ -82,14 +88,16 @@ def init_weights(rng, shape, scheme="xavier", distribution=None, dtype=jnp.float
         return jax.random.normal(rng, shape, dtype) * jnp.sqrt(1.0 / fo)
     if scheme in ("var_scaling_normal_fan_avg", "varscalingnormalfanavg"):
         return jax.random.normal(rng, shape, dtype) * jnp.sqrt(2.0 / n)
+    # VAR_SCALING_UNIFORM_*: reference WeightInitUtil.java:136-147 uses
+    # bound 3/sqrt(fan) (not Keras's sqrt(3/fan)); parity follows the code
     if scheme in ("var_scaling_uniform_fan_in", "varscalinguniformfanin"):
-        b = jnp.sqrt(3.0 / fi)
+        b = 3.0 / jnp.sqrt(fi)
         return jax.random.uniform(rng, shape, dtype, -b, b)
     if scheme in ("var_scaling_uniform_fan_out", "varscalinguniformfanout"):
-        b = jnp.sqrt(3.0 / fo)
+        b = 3.0 / jnp.sqrt(fo)
         return jax.random.uniform(rng, shape, dtype, -b, b)
     if scheme in ("var_scaling_uniform_fan_avg", "varscalinguniformfanavg"):
-        b = jnp.sqrt(6.0 / n)
+        b = 3.0 / jnp.sqrt(n / 2.0)
         return jax.random.uniform(rng, shape, dtype, -b, b)
     if scheme == "distribution":
         if distribution is None:

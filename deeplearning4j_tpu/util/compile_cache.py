@@ -1,0 +1,30 @@
+"""Persistent XLA compilation cache setup.
+
+On tunneled TPU attachments every compile is a remote RPC (~20-120 s per
+program, occasionally failing transiently); the persistent cache is
+verified to hit across processes in this environment, so a pre-warmed
+cache directory makes later runs (benchmarks, artifact training, the
+driver's recorded bench) pay ~0 compile time.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def setup_compile_cache(cache_dir=None) -> str:
+    """Point JAX at a persistent compilation cache directory (idempotent).
+
+    Resolution: explicit arg > ``DL4JTPU_JAX_CACHE`` env > ``.jax_cache``
+    at the repo root. Returns the directory used."""
+    d = (cache_dir or os.environ.get("DL4JTPU_JAX_CACHE")
+         or str(Path(__file__).resolve().parents[2] / ".jax_cache"))
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", str(d))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+    return str(d)

@@ -10,11 +10,11 @@ from deeplearning4j_tpu.zoo.simple import (
     LeNet, SimpleCNN, AlexNet, VGG16, VGG19, Darknet19, TextGenerationLSTM,
     TinyTransformer,
 )
-from deeplearning4j_tpu.zoo.resnet import ResNet50
+from deeplearning4j_tpu.zoo.resnet import ResNet50, ResNet50Cifar
 from deeplearning4j_tpu.zoo.inception import (
     GoogLeNet, InceptionResNetV1, FaceNetNN4Small2,
 )
 
 __all__ = ["ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
-           "Darknet19", "TextGenerationLSTM", "TinyTransformer", "ResNet50", "GoogLeNet",
+           "Darknet19", "TextGenerationLSTM", "TinyTransformer", "ResNet50", "ResNet50Cifar", "GoogLeNet",
            "InceptionResNetV1", "FaceNetNN4Small2"]

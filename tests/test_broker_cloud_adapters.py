@@ -1,0 +1,113 @@
+"""Broker/cloud adapter shims (parity: dl4j-streaming kafka route +
+deeplearning4j-aws S3 reader/uploader), contract-tested against the
+in-process fakes — the optional real backends (kafka-python, boto3) share
+the exact same protocol surface."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.kafka import (
+    InMemoryBroker, NDArrayPublisher, NDArrayPubSubRoute, default_client)
+from deeplearning4j_tpu.scaleout.s3 import (
+    LocalFileStore, S3Downloader, S3Uploader)
+
+
+def test_kafka_route_end_to_end_records_to_datasets():
+    broker = InMemoryBroker()
+    pub = NDArrayPublisher(broker, "train-topic")
+    route = NDArrayPubSubRoute(broker, "train-topic", batch_size=4).start()
+    rs = np.random.RandomState(0)
+    sent = [(rs.rand(3).astype(np.float32),
+             np.eye(2, dtype=np.float32)[i % 2]) for i in range(8)]
+    for f, l in sent:
+        pub.publish(f, l)
+    ds1 = next(route.iterator)
+    ds2 = next(route.iterator)
+    route.stop()
+    got_f = np.concatenate([ds1.features, ds2.features])
+    np.testing.assert_allclose(got_f, np.stack([f for f, _ in sent]),
+                               rtol=1e-6)
+    with pytest.raises(StopIteration):
+        next(route.iterator)               # stream ended cleanly
+
+
+def test_kafka_route_trains_a_net():
+    """The route feeds MultiLayerNetwork.fit like any other iterator."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    broker = InMemoryBroker()
+    pub = NDArrayPublisher(broker, "t")
+    route = NDArrayPubSubRoute(broker, "t", batch_size=8).start()
+    rs = np.random.RandomState(1)
+    for _ in range(16):
+        x = rs.randn(4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[int(x.sum() > 0)]
+        pub.publish(x, y)
+    import time
+    deadline = time.monotonic() + 5.0
+    while broker.pending("t") and time.monotonic() < deadline:
+        time.sleep(0.01)            # wait for the pump to drain the topic
+    route.stop()                    # joins the pump, then ends the stream
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(route.iterator)
+    route.stop(end_stream=False)
+    assert np.isfinite(net.get_score())
+
+
+def test_default_client_names_optional_dependency():
+    with pytest.raises(ImportError, match="kafka-python"):
+        default_client()
+
+
+def test_s3_contract_roundtrip(tmp_path):
+    store = LocalFileStore(tmp_path / "store")
+    src = tmp_path / "model.bin"
+    src.write_bytes(b"\x01\x02\x03")
+    up = S3Uploader(store)
+    up.upload_file(src, "models", "v1/model.bin")
+    assert store.list_objects("models") == ["v1/model.bin"]
+    assert store.list_objects("models", prefix="v1/") == ["v1/model.bin"]
+    dst = S3Downloader(store).download("models", "v1/model.bin",
+                                       tmp_path / "out" / "model.bin")
+    assert dst.read_bytes() == b"\x01\x02\x03"
+    store.delete("models", "v1/model.bin")
+    assert store.list_objects("models") == []
+
+
+def test_s3_upload_dir_and_prefix_download(tmp_path):
+    d = tmp_path / "bundle"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.txt").write_text("a")
+    (d / "sub" / "b.txt").write_text("b")
+    store = LocalFileStore(tmp_path / "store")
+    n = S3Uploader(store).upload_dir(d, "bk", prefix="data")
+    assert n == 2
+    got = S3Downloader(store).download_prefix("bk", "data",
+                                              tmp_path / "fetched")
+    assert sorted(p.name for p in got) == ["a.txt", "b.txt"]
+    assert (tmp_path / "fetched" / "sub" / "b.txt").read_text() == "b"
+
+
+def test_s3_download_dataset_lands_in_fetcher_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4JTPU_DATA_DIR", str(tmp_path / "cache"))
+    store = LocalFileStore(tmp_path / "store")
+    src = tmp_path / "iris.csv"
+    src.write_text("5.1,3.5,1.4,0.2,0\n")
+    S3Uploader(store).upload_file(src, "datasets", "iris/iris.csv")
+    S3Downloader(store).download_dataset("datasets", "iris", "iris")
+    from deeplearning4j_tpu.data.fetchers import data_dir
+    assert (data_dir() / "iris" / "iris.csv").exists()
+
+
+def test_s3_store_gates_optional_dependency():
+    from deeplearning4j_tpu.scaleout.s3 import S3ObjectStore
+    with pytest.raises(ImportError, match="boto3"):
+        S3ObjectStore()

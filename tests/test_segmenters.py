@@ -1,0 +1,80 @@
+"""CJK dictionary segmenter behind the TokenizerFactory SPI.
+
+Parity role: the reference's deeplearning4j-nlp-{chinese,japanese,korean}
+modules plug dictionary segmenters into the same TokenizerFactory seam the
+whitespace tokenizer uses; these tests prove the seam with a real
+(bidirectional maximal-matching) segmenter on bundled CJK fixtures — the
+segmenter produces WORDS, Word2Vec consumes them unchanged.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.segmenters import (
+    DictionarySegmenterTokenizerFactory, MaxMatchSegmenter,
+    load_bundled_lexicon)
+
+
+def test_zh_segments_real_words():
+    f = DictionarySegmenterTokenizerFactory("zh")
+    assert f.create("我们喜欢使用机器学习和自然语言处理").get_tokens() == [
+        "我们", "喜欢", "使用", "机器学习", "和", "自然语言处理"]
+    # longest match wins: 机器学习 beats 机器+学习
+    assert "机器学习" in f.create("机器学习模型").get_tokens()
+
+
+def test_ja_segments_real_words():
+    f = DictionarySegmenterTokenizerFactory("ja")
+    assert f.create("私は機械学習が好きです").get_tokens() == [
+        "私", "は", "機械学習", "が", "好き", "です"]
+
+
+def test_mixed_script_keeps_whitespace_semantics():
+    f = DictionarySegmenterTokenizerFactory("zh")
+    assert f.create("深度学习模型在TPU hardware上训练").get_tokens() == [
+        "深度学习", "模型", "在", "TPU", "hardware", "上", "训练"]
+
+
+def test_oov_falls_back_to_single_chars():
+    seg = MaxMatchSegmenter(["机器学习"])
+    assert seg.segment("机器学习硬件") == ["机器学习", "硬", "件"]
+
+
+def test_bidirectional_disambiguation_prefers_fewer_words():
+    # forward greedy over 研究生命 with this lexicon yields 研究生+命 (2);
+    # backward yields 研究+生命 (2) — tie, equal singles → backward, the
+    # linguistically right split here
+    seg = MaxMatchSegmenter(["研究", "研究生", "生命"])
+    assert seg.segment("研究生命") == ["研究", "生命"]
+
+
+def test_custom_lexicon_is_swappable():
+    seg = DictionarySegmenterTokenizerFactory(lexicon=["深度", "学习"])
+    assert seg.create("深度学习").get_tokens() == ["深度", "学习"]
+
+
+def test_spi_feeds_word2vec_with_real_words():
+    """The extension point demonstrated end-to-end: Word2Vec trained through
+    the segmenter factory builds its vocab from segmented WORDS."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents = ["我们喜欢机器学习",
+             "我们研究自然语言处理",
+             "机器学习模型训练数据",
+             "自然语言处理使用词向量"] * 12
+    w2v = Word2Vec(min_word_frequency=5, layer_size=16, window_size=2,
+                   epochs=1, negative=2, seed=3, subsampling=0,
+                   sentences=sents,
+                   tokenizer_factory=DictionarySegmenterTokenizerFactory("zh"))
+    w2v.build_vocab()
+    vocab = set(w2v.vocab.words())
+    assert {"机器学习", "我们", "自然语言处理", "训练"} <= vocab
+    assert not any(len(w) == 1 for w in vocab)   # words, not characters
+    w2v.fit()
+    assert np.isfinite(np.asarray(w2v.syn0)).all()
+
+
+def test_bundled_lexicons_load():
+    for lang in ("zh", "ja"):
+        words = load_bundled_lexicon(lang)
+        assert len(words) > 50
+        assert all(" " not in w for w in words)
