@@ -137,28 +137,49 @@ class NDArrayPubSubRoute:
                  buffer_records: int = 1024):
         self.client = client
         self.topic = topic
+        # finite push timeout so a backpressure-blocked pump re-checks the
+        # stop flag instead of blocking in the buffer forever
         self.iterator = StreamingDataSetIterator(
-            batch_size, buffer_records=buffer_records)
+            batch_size, buffer_records=buffer_records, push_timeout=0.5)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "NDArrayPubSubRoute":
         if self._thread is not None:
             return self
+        self._stop.clear()                     # restartable after stop()
 
         def pump():
+            import queue as _queue
             while not self._stop.is_set():
                 for msg in self.client.poll(self.topic, timeout=0.1):
-                    self.iterator.push_encoded(msg.decode())
+                    line = msg.decode()
+                    while True:                # backpressure with stop checks
+                        try:
+                            self.iterator.push_encoded(line)
+                            break
+                        except _queue.Full:
+                            if self._stop.is_set():
+                                return
+                        except RuntimeError:
+                            # stream ended under us (stop() raced a blocked
+                            # push): this pump is done; remaining polled
+                            # messages are part of the shutdown discard
+                            return
 
         self._thread = threading.Thread(target=pump, daemon=True)
         self._thread.start()
         return self
 
     def stop(self, end_stream: bool = True) -> None:
+        """Stop pumping; with ``end_stream`` also close the iterator so
+        consumers drain the buffer and see StopIteration. Messages the pump
+        had polled but not yet pushed when a blocked shutdown races are
+        discarded — shutdown is not a durability point (ack/commit
+        semantics belong to the broker client)."""
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=2.0)
+            self._thread.join(timeout=5.0)
             self._thread = None
         if end_stream:
             self.iterator.end()

@@ -238,13 +238,22 @@ class ComputationGraph:
         return new_params, new_opt
 
     # ----------------------------------------------------------- train step
+    def _loss_for_grad(self):
+        """jax.checkpoint-wrapped loss when remat is configured (see
+        GlobalConf.remat / MultiLayerNetwork._loss_for_grad)."""
+        if self.conf.global_conf.remat:
+            return jax.checkpoint(self._loss)
+        return self._loss
+
     def _make_train_step(self):
+        loss_fn = self._loss_for_grad()
+
         def step(params, state, opt_state, inputs, labels, it, masks, label_masks):
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.conf.global_conf.seed), it)
             (loss, new_state), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, state, inputs, labels, rng,
-                                          masks, label_masks)
+                loss_fn, has_aux=True)(params, state, inputs, labels, rng,
+                                       masks, label_masks)
             new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
             return new_params, new_state, new_opt, loss
 
@@ -268,6 +277,8 @@ class ComputationGraph:
         inputs_steps = [jnp.asarray(a) for a in inputs_steps]
         labels_steps = [jnp.asarray(a) for a in labels_steps]
         if self._scan_fit is None:
+            loss_fn = self._loss_for_grad()
+
             def inner(params, state, opt_state, xs, ys, it0):
                 def body(carry, inp):
                     params, state, opt_state, it = carry
@@ -275,8 +286,8 @@ class ComputationGraph:
                     rng = jax.random.fold_in(
                         jax.random.PRNGKey(self.conf.global_conf.seed), it)
                     (loss, new_state), grads = jax.value_and_grad(
-                        self._loss, has_aux=True)(params, state, x, y, rng,
-                                                  None, None)
+                        loss_fn, has_aux=True)(params, state, x, y, rng,
+                                               None, None)
                     params, opt_state = self._dp_apply_updates(
                         params, opt_state, grads)
                     return (params, new_state, opt_state, it + 1), loss

@@ -216,13 +216,23 @@ class MultiLayerNetwork:
         return new_params, new_opt
 
     # ----------------------------------------------------------- train step
+    def _loss_for_grad(self):
+        """The differentiated loss: jax.checkpoint-wrapped when remat is
+        configured (recompute activations in the backward — faster AND
+        smaller for HBM-bound conv models, see GlobalConf.remat)."""
+        if self.conf.global_conf.remat:
+            return jax.checkpoint(self._loss)
+        return self._loss
+
     def _make_train_step(self, with_masks, with_carries):
+        loss_fn = self._loss_for_grad()
+
         def step(params, state, opt_state, x, y, it, mask_f, mask_l, carries):
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.conf.global_conf.seed), it)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, state, x, y, rng,
-                                          mask_f, mask_l, carries)
+                loss_fn, has_aux=True)(params, state, x, y, rng,
+                                       mask_f, mask_l, carries)
             new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
             return new_params, new_state, new_opt, loss, new_carries
 
@@ -253,6 +263,8 @@ class MultiLayerNetwork:
                 "truncated BPTT must use fit() (the tbptt chunking path)")
         xs, ys = jnp.asarray(xs), jnp.asarray(ys)
         if self._scan_fit is None:
+            loss_fn = self._loss_for_grad()
+
             def inner(params, state, opt_state, xs, ys, it0):
                 def body(carry, inp):
                     params, state, opt_state, it = carry
@@ -260,8 +272,8 @@ class MultiLayerNetwork:
                     rng = jax.random.fold_in(
                         jax.random.PRNGKey(self.conf.global_conf.seed), it)
                     (loss, (new_state, _)), grads = jax.value_and_grad(
-                        self._loss, has_aux=True)(params, state, x, y, rng,
-                                                  None, None, None)
+                        loss_fn, has_aux=True)(params, state, x, y, rng,
+                                               None, None, None)
                     params, opt_state = self._dp_apply_updates(
                         params, opt_state, grads)
                     return (params, new_state, opt_state, it + 1), loss

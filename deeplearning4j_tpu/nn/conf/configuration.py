@@ -54,6 +54,12 @@ class GlobalConf:
     gradient_normalization_threshold: float = 1.0
     dtype: str = "float32"               # param dtype
     compute_dtype: Optional[str] = None  # e.g. 'bfloat16' for MXU-friendly fwd/bwd
+    # rematerialize activations in the backward pass (jax.checkpoint over
+    # the loss). On TPU the conv-net backward is HBM-bound on stored
+    # activations; recomputing them is measured 1.4-3x FASTER for
+    # ResNet50-class models besides the memory saving (docs/PERF_R05.md) —
+    # the role cudnn workspace tuning plays in the reference's helper seam
+    remat: bool = False
     weight_noise: Optional[object] = None  # IWeightNoise (DropConnect/...)
 
     def defaults_dict(self):
@@ -156,6 +162,9 @@ class Builder:
 
     def compute_dtype(self, dt):
         self._g.compute_dtype = dt; return self
+
+    def remat(self, flag=True):
+        self._g.remat = flag; return self
 
     def weight_noise(self, wn):
         """DropConnect / WeightNoise applied to every layer (parity:

@@ -47,6 +47,8 @@ class ZooModel:
         cd = self.kwargs.get("compute_dtype")
         if cd:
             conf.global_conf.compute_dtype = cd
+        if self.kwargs.get("remat"):
+            conf.global_conf.remat = True
         from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
         from deeplearning4j_tpu.models import MultiLayerNetwork, ComputationGraph
         if isinstance(conf, MultiLayerConfiguration):

@@ -1,0 +1,71 @@
+"""Backward rematerialization (GlobalConf.remat): identical training math,
+different schedule. Remat recomputes activations in the backward instead of
+storing them — on TPU this is faster for HBM-bound conv models and is the
+bench configuration for ResNet50 (docs/PERF_R05.md); these tests pin that
+it changes NOTHING numerically."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          BatchNormalization, OutputLayer)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _conf(remat):
+    b = (NeuralNetConfiguration.builder()
+         .seed(7).updater(Adam(1e-2)).weight_init("xavier"))
+    if remat:
+        b = b.remat()
+    return (b.list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=3, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+
+
+def _data(steps=3, b=4):
+    rs = np.random.RandomState(0)
+    xs = rs.rand(steps, b, 8, 8, 1).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (steps, b))]
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+def test_remat_mln_identical_training():
+    xs, ys = _data()
+    nets = [MultiLayerNetwork(_conf(r)).init() for r in (False, True)]
+    for net in nets:
+        net.fit_scan(xs, ys)
+    a, b = nets
+    assert np.allclose(float(a.get_score()), float(b.get_score()), atol=1e-5)
+    for pa, pb in zip(a.params, b.params):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
+                                       atol=1e-5)
+
+
+def test_remat_cg_identical_training():
+    from deeplearning4j_tpu.zoo.resnet import ResNet50Cifar
+    rs = np.random.RandomState(1)
+    x = rs.rand(4, 32, 32, 3).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 4)]
+    xs, ys = jnp.asarray(x[None]), jnp.asarray(y[None])
+    cgs = [ResNet50Cifar(num_classes=10, remat=r).init() for r in (False, True)]
+    for cg in cgs:
+        cg.fit_scan(xs, ys)
+    sa, sb = (float(c.get_score()) for c in cgs)
+    assert np.isfinite(sa) and abs(sa - sb) < 1e-4, (sa, sb)
+
+
+def test_remat_roundtrips_in_conf_json():
+    conf = _conf(True)
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    again = MultiLayerConfiguration.from_json(conf.to_json())
+    assert again.global_conf.remat is True
+    assert MultiLayerConfiguration.from_json(
+        _conf(False).to_json()).global_conf.remat is False

@@ -15,7 +15,6 @@ Variants:
               reductions + no stat EMA) — attributes BN's train-mode cost
   remat       full step with jax.checkpoint over the loss (recompute
               activations in backward: trades FLOPs for HBM)
-  nol2        full step with l2=0 (attributes weight-decay elementwise)
 """
 
 from __future__ import annotations
@@ -80,7 +79,8 @@ def profile(config="cifar512", variants=None):
                    compute_dtype="bfloat16").init()
 
     def loss_fn(params, state, xx, yy):
-        l, (st, _) = net._loss(params, state, xx, yy, None, None, None)
+        # CG takes input/label LISTS (multi-input graphs)
+        l, st = net._loss(params, state, [xx], [yy], None, None, None)
         return l, st
 
     def make(variant):
@@ -104,13 +104,16 @@ def profile(config="cifar512", variants=None):
                 p2, o2 = net._dp_apply_updates(params, opt_state, g)
                 return l, p2, o2
             return jax.jit(f), (net.params, net.state, net.opt_state)
+        if variant not in ("full", "bn_eval"):
+            raise ValueError(f"unknown variant '{variant}'")
         if variant == "bn_eval":
             # eval-mode forward (BN running stats: no batch-stat reductions,
             # no EMA) + softmax-CE on the output activations
             def f(params, state, opt_state):
                 def l_fn(p):
-                    act, _, _ = net._forward(p, state, x, train=False,
-                                             rng=None)
+                    acts, _, _ = net._forward(p, state, [x], train=False,
+                                              rng=None)
+                    act = acts[net.conf.network_outputs[0]]
                     eps = 1e-9
                     return -jnp.mean(jnp.sum(
                         y * jnp.log(act.astype(jnp.float32) + eps), -1))
@@ -128,16 +131,10 @@ def profile(config="cifar512", variants=None):
 
     variants = variants or ["full", "fwd", "grad", "bn_eval", "remat"]
     results = {}
+    bench = _bench_core()
     for v in variants:
         fn, args = make(v)
-        try:
-            lowered = fn.lower(*args).compile()
-            an = lowered.cost_analysis()
-            if isinstance(an, (list, tuple)):
-                an = an[0]
-            fl = float(an["flops"])
-        except Exception:
-            fl = None
+        fl = bench._cost_flops(fn, *args)
         sec = _time_jitted(fn, args)
         mfu = fl / sec / V5E_PEAK if fl else None
         results[v] = (sec, fl, mfu)
