@@ -145,18 +145,25 @@ class NDArrayPubSubRoute:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "NDArrayPubSubRoute":
+        """Start the pump. ``stop(end_stream=False)`` pauses and start()
+        resumes; after a terminal ``stop()`` (stream ended) the route
+        cannot be restarted — create a new one."""
         if self._thread is not None:
             return self
-        self._stop.clear()                     # restartable after stop()
+        if self.iterator.closed:
+            raise RuntimeError(
+                "route stream was ended; create a new NDArrayPubSubRoute")
+        self._stop.clear()
 
         def pump():
             import queue as _queue
+            from deeplearning4j_tpu.data.streaming import decode_record
             while not self._stop.is_set():
                 for msg in self.client.poll(self.topic, timeout=0.1):
-                    line = msg.decode()
+                    f, l = decode_record(msg.decode())   # decode ONCE
                     while True:                # backpressure with stop checks
                         try:
-                            self.iterator.push_encoded(line)
+                            self.iterator.push(f, l)
                             break
                         except _queue.Full:
                             if self._stop.is_set():

@@ -105,6 +105,10 @@ class StreamingDataSetIterator(DataSetIterator):
         """Close the stream; consumers drain what's buffered, then stop."""
         self._closed.set()
 
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
     # ------------------------------------------------------------- consumer
     def reset(self):
         pass     # forward-only, like a bus consumer's offset

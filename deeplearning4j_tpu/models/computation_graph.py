@@ -241,9 +241,8 @@ class ComputationGraph:
     def _loss_for_grad(self):
         """jax.checkpoint-wrapped loss when remat is configured (see
         GlobalConf.remat / MultiLayerNetwork._loss_for_grad)."""
-        if self.conf.global_conf.remat:
-            return jax.checkpoint(self._loss)
-        return self._loss
+        from deeplearning4j_tpu.util.remat import remat_loss
+        return remat_loss(self._loss, self.conf.global_conf.remat)
 
     def _make_train_step(self):
         loss_fn = self._loss_for_grad()

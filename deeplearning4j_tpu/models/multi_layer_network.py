@@ -220,9 +220,8 @@ class MultiLayerNetwork:
         """The differentiated loss: jax.checkpoint-wrapped when remat is
         configured (recompute activations in the backward — faster AND
         smaller for HBM-bound conv models, see GlobalConf.remat)."""
-        if self.conf.global_conf.remat:
-            return jax.checkpoint(self._loss)
-        return self._loss
+        from deeplearning4j_tpu.util.remat import remat_loss
+        return remat_loss(self._loss, self.conf.global_conf.remat)
 
     def _make_train_step(self, with_masks, with_carries):
         loss_fn = self._loss_for_grad()

@@ -21,6 +21,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, as_pair, require_dims
 from deeplearning4j_tpu.nn.activations import get_activation
@@ -75,13 +76,17 @@ class ConvolutionLayer(Layer):
         return p
 
     def _conv(self, x, w):
-        return lax.conv_general_dilated(
+        y = lax.conv_general_dilated(
             x, w, window_strides=self.stride,
             padding=_padding_config(self.convolution_mode, self.kernel_size,
                                     self.stride, self.padding, self.dilation),
             rhs_dilation=self.dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
+        # named for selective rematerialization (GlobalConf.remat =
+        # 'save_convs': keep conv outputs, recompute BN/activations);
+        # identity outside a remat context
+        return checkpoint_name(y, "conv_out")
 
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)

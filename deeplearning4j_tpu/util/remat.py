@@ -1,0 +1,26 @@
+"""Shared backward-rematerialization dispatch for the network containers.
+
+See GlobalConf.remat (nn/conf/configuration.py) for the modes and
+docs/PERF_R05.md for the measurements behind them.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def remat_loss(loss_fn, mode):
+    """``loss_fn`` wrapped per the configured remat ``mode``:
+    False → unchanged; True/'full' → jax.checkpoint;
+    'save_convs'/'selective' → checkpoint saving only named conv outputs
+    (ConvolutionLayer tags them "conv_out")."""
+    if not mode:
+        return loss_fn
+    if mode in (True, "full"):
+        return jax.checkpoint(loss_fn)
+    if mode in ("save_convs", "selective"):
+        return jax.checkpoint(
+            loss_fn,
+            policy=jax.checkpoint_policies.save_only_these_names("conv_out"))
+    raise ValueError(f"unknown remat mode {mode!r} "
+                     "(False | True | 'full' | 'save_convs')")

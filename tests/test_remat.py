@@ -19,7 +19,7 @@ def _conf(remat):
     b = (NeuralNetConfiguration.builder()
          .seed(7).updater(Adam(1e-2)).weight_init("xavier"))
     if remat:
-        b = b.remat()
+        b = b.remat(remat)
     return (b.list()
             .layer(ConvolutionLayer(n_out=4, kernel_size=3, activation="relu"))
             .layer(BatchNormalization())
@@ -38,15 +38,25 @@ def _data(steps=3, b=4):
 
 def test_remat_mln_identical_training():
     xs, ys = _data()
-    nets = [MultiLayerNetwork(_conf(r)).init() for r in (False, True)]
+    nets = [MultiLayerNetwork(_conf(r)).init()
+            for r in (False, True, "save_convs")]
     for net in nets:
         net.fit_scan(xs, ys)
-    a, b = nets
-    assert np.allclose(float(a.get_score()), float(b.get_score()), atol=1e-5)
-    for pa, pb in zip(a.params, b.params):
-        for k in pa:
-            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
-                                       atol=1e-5)
+    a = nets[0]
+    for b in nets[1:]:
+        assert np.allclose(float(a.get_score()), float(b.get_score()),
+                           atol=1e-5)
+        for pa, pb in zip(a.params, b.params):
+            for k in pa:
+                np.testing.assert_allclose(np.asarray(pa[k]),
+                                           np.asarray(pb[k]), atol=1e-5)
+
+
+def test_remat_rejects_unknown_mode():
+    net = MultiLayerNetwork(_conf(False))
+    net.conf.global_conf.remat = "bogus"
+    with pytest.raises(ValueError, match="remat"):
+        net.init().fit_scan(*_data(1))
 
 
 def test_remat_cg_identical_training():
