@@ -20,7 +20,7 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 from deeplearning4j_tpu.data.streaming import (
-    StreamingDataSetIterator, encode_record)
+    StreamingDataSetIterator, decode_record, encode_record)
 
 
 class BrokerClient:
@@ -157,7 +157,6 @@ class NDArrayPubSubRoute:
 
         def pump():
             import queue as _queue
-            from deeplearning4j_tpu.data.streaming import decode_record
             while not self._stop.is_set():
                 for msg in self.client.poll(self.topic, timeout=0.1):
                     f, l = decode_record(msg.decode())   # decode ONCE

@@ -166,7 +166,8 @@ class Builder:
         self._g.compute_dtype = dt; return self
 
     def remat(self, flag=True):
-        self._g.remat = flag; return self
+        from deeplearning4j_tpu.util.remat import check_remat_mode
+        self._g.remat = check_remat_mode(flag); return self
 
     def weight_noise(self, wn):
         """DropConnect / WeightNoise applied to every layer (parity:

@@ -292,6 +292,7 @@ ATTN_QUICK = [(2, 16, 8), (8, 128, 64)]
 def run(quick=False, time_it=True):
     results = []
     failures = []
+    skipped = []
     lstm_cases = LSTM_QUICK if quick else LSTM_SWEEP
     attn_cases = ATTN_QUICK if quick else ATTN_SWEEP
     for b, t, h in lstm_cases:
@@ -305,8 +306,18 @@ def run(quick=False, time_it=True):
                                  "H": h, "dtype": dtype,
                                  "error": f"{type(e).__name__}: {e}"[:300]})
                 print(json.dumps(failures[-1]))
+    from deeplearning4j_tpu.ops.lstm_pallas import supported2 as _sup2
     for b, t, h in (LSTM2_QUICK if quick else LSTM2_SWEEP):
         for dtype in ("float32", "bfloat16"):
+            if not _sup2(b, t, h, np.dtype(dtype).itemsize):
+                # expected screen rejection, not a defect: the container
+                # falls back to the per-layer kernels for this shape
+                skipped.append({"kernel": "fused_lstm2", "B": b, "T": t,
+                                "H": h, "dtype": dtype, "skipped":
+                                "outside supported2() VMEM screen — "
+                                "container falls back to per-layer kernels"})
+                print(json.dumps(skipped[-1]))
+                continue
             try:
                 r = validate_lstm2_case(b, t, h, dtype, time_it=time_it)
                 results.append(r)
@@ -329,22 +340,31 @@ def run(quick=False, time_it=True):
                 print(json.dumps(failures[-1]))
     summary = {"backend": jax.default_backend(),
                "device": jax.devices()[0].device_kind,
-               "passed": len(results), "failed": len(failures)}
+               "passed": len(results), "failed": len(failures),
+               "skipped": len(skipped)}
     print(json.dumps(summary))
-    return results, failures
+    return results, failures, skipped
 
 
 if __name__ == "__main__":
+    from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
+    setup_compile_cache()          # remote compiles dominate the sweep
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--no-time", action="store_true")
     ap.add_argument("--out", default=None,
                     help="write results+failures JSON to this path")
     a = ap.parse_args()
-    results, failures = run(quick=a.quick, time_it=not a.no_time)
+    results, failures, skipped = run(quick=a.quick, time_it=not a.no_time)
     if a.out:
         with open(a.out, "w") as f:
             json.dump({"results": results, "failures": failures,
+                       "skipped": skipped,
                        "backend": jax.default_backend(),
-                       "device": jax.devices()[0].device_kind}, f, indent=1)
+                       "device": jax.devices()[0].device_kind,
+                       "note": "Timing shares a pooled chip; tenancy "
+                       "contention swings identical runs up to ~2x "
+                       "(docs/PERF_R05.md). Correctness (max_err vs the "
+                       "scan reference) is the validation contract; "
+                       "per-shape speedups are one sample."}, f, indent=1)
     raise SystemExit(1 if failures else 0)

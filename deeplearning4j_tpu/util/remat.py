@@ -8,6 +8,17 @@ from __future__ import annotations
 
 import jax
 
+_MODES = (False, True, "full", "save_convs", "selective")
+
+
+def check_remat_mode(mode):
+    """Fail fast on an invalid mode (builder/zoo entry points call this so
+    a typo surfaces at configuration time, not at the first train step)."""
+    if mode not in _MODES:
+        raise ValueError(f"unknown remat mode {mode!r} "
+                         "(False | True | 'full' | 'save_convs')")
+    return mode
+
 
 def remat_loss(loss_fn, mode):
     """``loss_fn`` wrapped per the configured remat ``mode``:
@@ -22,5 +33,5 @@ def remat_loss(loss_fn, mode):
         return jax.checkpoint(
             loss_fn,
             policy=jax.checkpoint_policies.save_only_these_names("conv_out"))
-    raise ValueError(f"unknown remat mode {mode!r} "
-                     "(False | True | 'full' | 'save_convs')")
+    check_remat_mode(mode)                     # raises; not a known mode
+    raise AssertionError("unreachable")

@@ -48,7 +48,8 @@ class ZooModel:
         if cd:
             conf.global_conf.compute_dtype = cd
         if self.kwargs.get("remat"):
-            conf.global_conf.remat = self.kwargs["remat"]
+            from deeplearning4j_tpu.util.remat import check_remat_mode
+            conf.global_conf.remat = check_remat_mode(self.kwargs["remat"])
         from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
         from deeplearning4j_tpu.models import MultiLayerNetwork, ComputationGraph
         if isinstance(conf, MultiLayerConfiguration):

@@ -54,7 +54,7 @@ def test_remat_mln_identical_training():
 
 def test_remat_rejects_unknown_mode():
     net = MultiLayerNetwork(_conf(False))
-    net.conf.global_conf.remat = "bogus"
+    net.conf.global_conf.remat = "bogus"      # bypasses the eager check
     with pytest.raises(ValueError, match="remat"):
         net.init().fit_scan(*_data(1))
 
@@ -65,17 +65,26 @@ def test_remat_cg_identical_training():
     x = rs.rand(4, 32, 32, 3).astype(np.float32)
     y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 4)]
     xs, ys = jnp.asarray(x[None]), jnp.asarray(y[None])
-    cgs = [ResNet50Cifar(num_classes=10, remat=r).init() for r in (False, True)]
+    cgs = [ResNet50Cifar(num_classes=10, remat=r).init()
+           for r in (False, True, "save_convs")]
     for cg in cgs:
         cg.fit_scan(xs, ys)
-    sa, sb = (float(c.get_score()) for c in cgs)
-    assert np.isfinite(sa) and abs(sa - sb) < 1e-4, (sa, sb)
+    scores = [float(c.get_score()) for c in cgs]
+    assert np.isfinite(scores[0])
+    for s in scores[1:]:
+        assert abs(scores[0] - s) < 1e-4, scores
 
 
 def test_remat_roundtrips_in_conf_json():
-    conf = _conf(True)
     from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
-    again = MultiLayerConfiguration.from_json(conf.to_json())
+    again = MultiLayerConfiguration.from_json(_conf(True).to_json())
     assert again.global_conf.remat is True
     assert MultiLayerConfiguration.from_json(
         _conf(False).to_json()).global_conf.remat is False
+    assert MultiLayerConfiguration.from_json(
+        _conf("save_convs").to_json()).global_conf.remat == "save_convs"
+
+
+def test_remat_builder_rejects_bad_mode_eagerly():
+    with pytest.raises(ValueError, match="remat"):
+        NeuralNetConfiguration.builder().remat("save_conv")
