@@ -34,6 +34,9 @@ class BrokerClient:
         """Return available messages for ``topic`` (possibly empty)."""
         raise NotImplementedError
 
+    def flush(self) -> None:
+        """Force out any batched sends (no-op for synchronous brokers)."""
+
     def close(self) -> None:
         pass
 
@@ -71,18 +74,34 @@ class InMemoryBroker(BrokerClient):
 
 
 class KafkaPythonClient(BrokerClient):
-    """Adapter over the optional ``kafka-python`` package."""
+    """Adapter over the optional ``kafka-python`` package.
 
-    def __init__(self, bootstrap_servers: str = "localhost:9092", **kw):
+    Offset semantics: WITHOUT ``group_id`` each consumer starts at
+    ``auto_offset_reset='earliest'`` and commits nothing, so every new
+    process REPLAYS the topic from the beginning — the right default for
+    re-runnable training streams, but it means duplicates across restarts.
+    WITH ``group_id`` offsets are auto-committed to the broker and a
+    restarted process resumes where the group left off (at-least-once).
+
+    Sends are batched by the producer (``linger``/batch settings apply);
+    call ``flush()`` — or ``close()``, which flushes — at durability points
+    instead of paying a broker round-trip per message.
+    """
+
+    def __init__(self, bootstrap_servers: str = "localhost:9092",
+                 group_id: Optional[str] = None, **kw):
         import kafka  # optional dependency; ImportError is the gate
         self._producer = kafka.KafkaProducer(
             bootstrap_servers=bootstrap_servers, **kw)
         self._consumers: Dict[str, "kafka.KafkaConsumer"] = {}
         self._bootstrap = bootstrap_servers
+        self._group = group_id
         self._kw = kw
 
     def send(self, topic: str, value: bytes) -> None:
-        self._producer.send(topic, value)
+        self._producer.send(topic, value)   # batched; flush() to force out
+
+    def flush(self) -> None:
         self._producer.flush()
 
     def poll(self, topic: str, timeout: float = 0.1) -> List[bytes]:
@@ -91,27 +110,41 @@ class KafkaPythonClient(BrokerClient):
         if c is None:
             c = kafka.KafkaConsumer(topic,
                                     bootstrap_servers=self._bootstrap,
+                                    group_id=self._group,
+                                    enable_auto_commit=self._group is not None,
                                     auto_offset_reset="earliest", **self._kw)
             self._consumers[topic] = c
         recs = c.poll(timeout_ms=int(timeout * 1000))
         return [r.value for batch in recs.values() for r in batch]
 
     def close(self) -> None:
+        self._producer.flush()
         self._producer.close()
         for c in self._consumers.values():
             c.close()
 
 
-def default_client(bootstrap_servers: Optional[str] = None) -> BrokerClient:
+def default_client(bootstrap_servers: Optional[str] = None,
+                   group_id: Optional[str] = None) -> BrokerClient:
     """A real Kafka client when ``kafka-python`` is installed, else a clear
-    error naming the optional dependency (this image is air-gapped)."""
+    error naming the optional dependency (this image is air-gapped).
+    Broker-connection failures are wrapped in the same actionable style so
+    'package installed but no broker running' doesn't surface as a bare
+    NoBrokersAvailable deep in kafka internals."""
+    servers = bootstrap_servers or "localhost:9092"
     try:
-        return KafkaPythonClient(bootstrap_servers or "localhost:9092")
+        return KafkaPythonClient(servers, group_id=group_id)
     except ImportError as e:
         raise ImportError(
             "Kafka transport needs the optional 'kafka-python' package "
             "(pip install kafka-python), or pass any BrokerClient — e.g. "
             "InMemoryBroker for in-process use.") from e
+    except Exception as e:  # noqa: BLE001 — NoBrokersAvailable et al.
+        raise ConnectionError(
+            f"kafka-python is installed but no broker answered at "
+            f"{servers} ({type(e).__name__}: {e}); start a broker, pass "
+            "bootstrap_servers=, or use InMemoryBroker for in-process "
+            "pipelines.") from e
 
 
 class NDArrayPublisher:
@@ -124,6 +157,11 @@ class NDArrayPublisher:
     def publish(self, features, labels) -> None:
         self.client.send(self.topic,
                          encode_record(features, labels).encode())
+
+    def flush(self) -> None:
+        """Durability point: force out batched sends (see
+        KafkaPythonClient — ``send`` no longer flushes per message)."""
+        self.client.flush()
 
 
 class NDArrayPubSubRoute:

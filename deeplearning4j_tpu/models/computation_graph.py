@@ -49,6 +49,7 @@ class ComputationGraph:
         self._train_step_cache = {}
         self._scan_fit = None
         self._output_fn = None
+        self._serving = None          # bucketed inference engine (lazy)
         self._transforms = None
 
     # ------------------------------------------------------------------ init
@@ -82,6 +83,7 @@ class ComputationGraph:
         self._train_step_cache = {}
         self._scan_fit = None
         self._output_fn = None
+        self._serving = None
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -485,9 +487,26 @@ class ComputationGraph:
         self._score = jnp.mean(jnp.stack(losses))   # device-side mean
 
     # ------------------------------------------------------------- inference
-    def output(self, *inputs, train=False):
-        """Multi-output inference (parity: ComputationGraph.output :1532)."""
+    def serving_engine(self, **kw):
+        """The shape-bucketed inference engine for this graph (lazy, shared
+        by ``output``/``evaluate``; see serving/engine.py)."""
+        if self._serving is None:
+            from deeplearning4j_tpu.serving.engine import InferenceEngine
+            self._serving = InferenceEngine(self, **kw)
+        return self._serving
+
+    def output(self, *inputs, train=False, bucketed=True):
+        """Multi-output inference (parity: ComputationGraph.output :1532).
+
+        Default fast path is shape-bucketed (see
+        MultiLayerNetwork.output): every input is padded to the same
+        power-of-two batch bucket and pad rows are sliced off the outputs,
+        so a handful of compiled programs serve every request size.
+        ``bucketed=False`` forces the exact-shape program."""
         inputs = [jnp.asarray(x) for x in inputs]
+        if bucketed:
+            outs = self.serving_engine().predict(list(inputs))
+            return outs
         if self._output_fn is None:
             def fwd(params, state, inputs):
                 acts, _, _ = self._forward(params, state, inputs, train=False,
@@ -581,6 +600,9 @@ class ComputationGraph:
         self._rnn_carries = None
 
     def evaluate(self, data):
+        """First-output classification eval, dispatched through the
+        bucketed engine with the host read pipelined one batch behind the
+        device (see MultiLayerNetwork._eval_stream)."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
         ev = Evaluation()
@@ -588,13 +610,20 @@ class ComputationGraph:
             data = [data]
         elif hasattr(data, "reset"):
             data.reset()
-        for ds in data:
-            if isinstance(ds, DataSet):
-                ds = ds.to_multi()
-            out = self.output(*ds.features)
+        eng = self.serving_engine()
+        labels = []
+
+        def feats():
+            for ds in data:
+                if isinstance(ds, DataSet):
+                    ds = ds.to_multi()
+                labels.append(ds.labels[0])
+                yield [jnp.asarray(f) for f in ds.features]
+
+        for i, out in enumerate(eng.predict_stream(feats())):
             if isinstance(out, list):
                 out = out[0]
-            ev.eval(np.asarray(ds.labels[0]), np.asarray(out))
+            ev.eval(np.asarray(labels[i]), out)
         return ev
 
     # ------------------------------------------------------------- utilities

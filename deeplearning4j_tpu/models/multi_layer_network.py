@@ -55,6 +55,7 @@ class MultiLayerNetwork:
         self._train_step_seq = None
         self._scan_fit = None
         self._output_fn = None
+        self._serving = None          # bucketed inference engine (lazy)
         self._transforms = None
 
     # ------------------------------------------------------------------ init
@@ -83,6 +84,7 @@ class MultiLayerNetwork:
         self._train_step = None  # force re-trace
         self._scan_fit = None
         self._output_fn = None
+        self._serving = None
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -487,9 +489,29 @@ class MultiLayerNetwork:
         self._score = jnp.mean(jnp.stack(losses))   # device-side mean
 
     # ------------------------------------------------------------- inference
-    def output(self, x, train=False, mask=None):
-        """Forward pass to network output (parity: output :1947)."""
+    def serving_engine(self, **kw):
+        """The shape-bucketed inference engine for this net (lazy, shared by
+        ``output``/``evaluate``; see serving/engine.py). Keyword args are
+        honored on first construction only."""
+        if self._serving is None:
+            from deeplearning4j_tpu.serving.engine import InferenceEngine
+            self._serving = InferenceEngine(self, **kw)
+        return self._serving
+
+    def output(self, x, train=False, mask=None, bucketed=True):
+        """Forward pass to network output (parity: output :1947).
+
+        Default fast path is shape-BUCKETED: the batch is zero-padded up to
+        a power-of-two bucket so ⌈log2(max_batch)⌉+1 compiled programs cover
+        every request size (each fresh compile is 20-120 s on tunneled TPU
+        attachments), with pad rows sliced off after the device call —
+        numerically identical because inference computes every output row
+        from its own input row alone. ``bucketed=False`` forces the legacy
+        exact-shape program (one compile per distinct batch size)."""
         x = jnp.asarray(x)
+        if bucketed:
+            return self.serving_engine().predict(
+                x, None if mask is None else jnp.asarray(mask))
         if self._output_fn is None:
             def fwd(params, state, x, mask):
                 act, _, _ = self._forward(params, state, x, train=False,
@@ -545,8 +567,34 @@ class MultiLayerNetwork:
         self._rnn_carries = None
 
     # ------------------------------------------------------------- evaluate
+    def _eval_stream(self, data, eval_fn):
+        """Shared bucketed+pipelined evaluation core: dispatch runs one
+        batch ahead of the host read, so the device executes batch k+1
+        while ``eval_fn`` consumes batch k (the serving engine's
+        predict_stream does the in-flight bookkeeping). ``eval_fn`` gets
+        (labels, host_output, labels_mask) per batch."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        eng = self.serving_engine()
+        metas = []
+
+        def feats():
+            for ds in data:
+                if not isinstance(ds, DataSet):
+                    ds = DataSet(*ds)
+                metas.append((ds.labels, ds.labels_mask))
+                yield ds.features
+
+        # predict_stream lags ≥1 batch behind feats(), so metas[i] is
+        # always populated before output i arrives
+        for i, out in enumerate(eng.predict_stream(feats())):
+            labels, lm = metas[i]
+            eval_fn(np.asarray(labels), out,
+                    None if lm is None else np.asarray(lm))
+
     def evaluate(self, data, labels=None):
-        """Classification evaluation (parity: MultiLayerNetwork.evaluate)."""
+        """Classification evaluation (parity: MultiLayerNetwork.evaluate),
+        batches dispatched through the bucketed engine with the host read
+        pipelined one batch behind the device."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         from deeplearning4j_tpu.data.dataset import DataSet
         ev = Evaluation()
@@ -556,12 +604,7 @@ class MultiLayerNetwork:
             data = [data]
         elif hasattr(data, "reset"):
             data.reset()
-        for ds in data:
-            if not isinstance(ds, DataSet):
-                ds = DataSet(*ds)
-            out = self.output(ds.features)
-            ev.eval(np.asarray(ds.labels), np.asarray(out),
-                    None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+        self._eval_stream(data, ev.eval)
         return ev
 
     def evaluate_regression(self, data):
@@ -572,9 +615,8 @@ class MultiLayerNetwork:
             data = [data]
         elif hasattr(data, "reset"):
             data.reset()
-        for ds in data:
-            out = self.output(ds.features)
-            ev.eval(np.asarray(ds.labels), np.asarray(out))
+        self._eval_stream(data,
+                          lambda y, out, _lm: ev.eval(y, out))
         return ev
 
     # ------------------------------------------------------------- utilities

@@ -55,13 +55,13 @@ class GlobalConf:
     dtype: str = "float32"               # param dtype
     compute_dtype: Optional[str] = None  # e.g. 'bfloat16' for MXU-friendly fwd/bwd
     # rematerialize activations in the backward pass (jax.checkpoint over
-    # the loss). True/'full' recomputes everything; 'save_convs' keeps conv
-    # outputs and recomputes only BN/activations. On TPU the conv-net
-    # backward is HBM-bound on stored activations: full remat measures up
-    # to 5x faster at CIFAR shapes, 'save_convs' wins at 224 where conv
-    # recompute costs real FLOPs (docs/PERF_R05.md) — the role cudnn
-    # workspace tuning plays in the reference's helper seam
-    remat: object = False                # False | True | 'full' | 'save_convs'
+    # the loss). True/'full' recomputes everything; 'save_convs' (alias
+    # 'selective') keeps conv outputs and recomputes only BN/activations.
+    # On TPU the conv-net backward is HBM-bound on stored activations: full
+    # remat measures up to 5x faster at CIFAR shapes, 'save_convs' wins at
+    # 224 where conv recompute costs real FLOPs (docs/PERF_R05.md) — the
+    # role cudnn workspace tuning plays in the reference's helper seam
+    remat: object = False   # False | True | 'full' | 'save_convs' | 'selective'
     weight_noise: Optional[object] = None  # IWeightNoise (DropConnect/...)
 
     def defaults_dict(self):

@@ -122,10 +122,23 @@ class S3Downloader:
         return self.store.download(bucket, key, local_path)
 
     def download_prefix(self, bucket: str, prefix: str, local_dir) -> List[Path]:
+        """Download every object under ``prefix`` into ``local_dir``,
+        stripping the prefix only at a ``/`` boundary: S3 prefixes are
+        plain character prefixes, so listing prefix ``data`` also returns
+        ``database/x.txt`` — that key keeps its full path locally instead
+        of being mangled to ``base/x.txt``."""
         local_dir = Path(local_dir)
+        p = prefix.rstrip("/")
         out = []
         for key in self.store.list_objects(bucket, prefix):
-            rel = key[len(prefix):].lstrip("/") or Path(key).name
+            if not p:
+                rel = key
+            elif key == p:
+                rel = Path(key).name
+            elif key.startswith(p + "/"):
+                rel = key[len(p) + 1:]
+            else:          # char-prefix match past the / boundary
+                rel = key
             out.append(self.store.download(bucket, key, local_dir / rel))
         return out
 

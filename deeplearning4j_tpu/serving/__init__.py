@@ -1,0 +1,23 @@
+"""High-throughput inference serving.
+
+The training path compiles ONE program per network and amortizes dispatch
+(fit_scan); this package does the same for inference: a shape-bucketed
+execution engine so a handful of compiled XLA programs cover every request
+size, a dynamic micro-batcher that coalesces concurrent requests into one
+device call, and an HTTP endpoint in the knn_server style. The reference has
+no serving layer at all — its ``output()`` dispatches per-op over JNI
+(MultiLayerNetwork.java:1947) — so this is where the XLA-native build wins.
+
+See docs/SERVING.md for the design and wire format.
+"""
+
+from deeplearning4j_tpu.serving.engine import (
+    InferenceEngine, bucket_ladder, bucket_for)
+from deeplearning4j_tpu.serving.batcher import MicroBatcher
+from deeplearning4j_tpu.serving.server import InferenceServer
+from deeplearning4j_tpu.serving.client import InferenceClient
+
+__all__ = [
+    "InferenceEngine", "MicroBatcher", "InferenceServer", "InferenceClient",
+    "bucket_ladder", "bucket_for",
+]

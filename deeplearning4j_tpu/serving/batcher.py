@@ -1,0 +1,139 @@
+"""Dynamic micro-batching: coalesce concurrent requests into one device call.
+
+Parity cousin: parallel/inference.py's ParallelInference merges requests to
+feed a sharded multi-device forward; this batcher is the single-engine
+serving variant — a bounded queue whose worker drains it under a
+max-latency / max-batch policy and answers each request with its slice of
+the merged result. Combined with the engine's shape buckets, a storm of
+odd-sized requests becomes a steady stream of identically-shaped device
+calls that never trigger a fresh XLA compile.
+
+Backpressure: the queue is bounded; ``submit`` blocks (up to
+``submit_timeout``) when serving falls behind, which is the knob that keeps
+a traffic spike from growing the heap without bound.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+
+class MicroBatcher:
+    """Merge concurrent ``submit()`` batches into single engine calls.
+
+    ``engine``: an InferenceEngine (or anything with ``predict_host``).
+    ``max_batch``: merged rows per device call (requests above this are
+    still served — the engine chunks internally). ``max_latency_ms``: how
+    long the worker waits for co-travellers after the first request of a
+    batch arrives; the classic throughput/latency trade.
+    """
+
+    def __init__(self, engine, max_batch: int = 256,
+                 max_latency_ms: float = 2.0, max_queue: int = 1024,
+                 submit_timeout: float = 30.0):
+        self.engine = engine
+        self.max_batch = int(max_batch)
+        self.max_latency_ms = float(max_latency_ms)
+        self.submit_timeout = submit_timeout
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # serving counters (exposed at /stats)
+        self.n_requests = 0
+        self.n_rows = 0
+        self.n_device_calls = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "MicroBatcher":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        # fail anything still queued so callers don't hang on dead futures
+        while True:
+            try:
+                _, fut = self._q.get_nowait()
+            except queue.Empty:
+                break
+            fut.set_exception(RuntimeError("micro-batcher stopped"))
+
+    # -------------------------------------------------------------- serving
+    def submit(self, x) -> Future:
+        """Queue a request batch (n, features...); returns a Future whose
+        result is the (n, ...) output slice. Blocks when the queue is full
+        (bounded-queue backpressure)."""
+        if self._thread is None:
+            self.start()
+        x = np.asarray(x)
+        fut: Future = Future()
+        self._q.put((x, fut), timeout=self.submit_timeout)
+        return fut
+
+    def predict(self, x):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(x).result()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            total = first[0].shape[0]
+            deadline = time.perf_counter() + self.max_latency_ms / 1000.0
+            while total < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                try:
+                    item = (self._q.get_nowait() if remaining <= 0
+                            else self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+                batch.append(item)
+                total += item[0].shape[0]
+                if remaining <= 0:
+                    break
+            try:
+                merged = (batch[0][0] if len(batch) == 1
+                          else np.concatenate([b[0] for b in batch]))
+                out = self.engine.predict_host(merged)
+                if isinstance(out, list):   # multi-output graph: first head
+                    out = out[0]
+                ofs = 0
+                for x, fut in batch:
+                    fut.set_result(out[ofs:ofs + x.shape[0]])
+                    ofs += x.shape[0]
+                with self._lock:
+                    self.n_requests += len(batch)
+                    self.n_rows += total
+                    self.n_device_calls += 1
+            except Exception as e:  # noqa: BLE001 — answer every caller
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            calls = self.n_device_calls
+            return {"requests": self.n_requests, "rows": self.n_rows,
+                    "device_calls": calls,
+                    "avg_merge": (self.n_requests / calls) if calls else 0.0,
+                    "queue_depth": self._q.qsize(),
+                    "max_batch": self.max_batch,
+                    "max_latency_ms": self.max_latency_ms}

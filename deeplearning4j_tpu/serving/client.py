@@ -1,0 +1,55 @@
+"""Client for the InferenceServer (JSON + Base64 f32, knn_server style)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.knn_server import (
+    ndarray_from_b64, ndarray_to_b64)
+
+
+class InferenceClient:
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, path, payload=None):
+        if payload is None:
+            req = urllib.request.Request(self.url + path)
+        else:
+            req = urllib.request.Request(
+                self.url + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                out = json.loads(e.read().decode())
+            except Exception:
+                raise RuntimeError(f"HTTP {e.code}") from e
+        if isinstance(out, dict) and "error" in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    def predict(self, x) -> np.ndarray:
+        """POST one request batch; a 1-D vector is treated as batch of 1
+        and the batch dim stripped from the reply (server mirrors this)."""
+        out = self._request(
+            "/predict", {"ndarray": ndarray_to_b64(np.asarray(x))})
+        return ndarray_from_b64(out["ndarray"])
+
+    def warmup(self, input_shape, max_batch=None) -> dict:
+        """Pre-compile the server's bucket ladder for ``input_shape`` (a
+        per-example feature shape, or list of shapes for graphs)."""
+        payload = {"input_shape": list(input_shape)}
+        if max_batch is not None:
+            payload["max_batch"] = int(max_batch)
+        return self._request("/warmup", payload)
+
+    def stats(self) -> dict:
+        return self._request("/stats")

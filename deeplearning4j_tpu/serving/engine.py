@@ -1,0 +1,220 @@
+"""Shape-bucketed inference execution.
+
+The naive path jits one forward per EXACT batch shape, so a traffic mix of
+request sizes pays one fresh XLA compile per distinct size — 20-120 s per
+program on tunneled TPU attachments (util/compile_cache.py). The engine
+instead pads every batch up to a small power-of-two ladder of bucket sizes:
+⌈log2(max_batch)⌉+1 compiled programs cover every request size from 1 to
+max_batch, and anything larger is chunked through the top bucket.
+
+Padding is numerics-neutral for inference: ``output()`` runs train=False, so
+every op the containers emit (dense/conv matmuls, pooling, BN with running
+stats, per-row softmax, per-example LSTM scan) computes row i of the output
+from row i of the input alone — pad rows are dead weight that is sliced off
+after the device call, and the engine's test suite pins the bucketed result
+bitwise-equal to the exact-shape forward. (Train-mode batch statistics WOULD
+couple rows; the engine is inference-only for exactly that reason.)
+
+``warmup()`` pre-executes the ladder through the persistent compilation
+cache (util/compile_cache.py), so a fresh server process — whose in-process
+jit cache starts empty — serves its first request with ~0 compile time.
+
+Trace accounting: the traced python body increments ``trace_count`` exactly
+once per new XLA program signature, giving tests and /stats an exact
+compiled-program count with no XLA internals involved.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def bucket_for(n: int, max_batch: int, min_bucket: int = 1) -> int:
+    """Smallest power-of-two ≥ n (clamped to [min_bucket, max_batch])."""
+    if n < 1:
+        raise ValueError(f"batch size must be ≥ 1, got {n}")
+    b = max(min_bucket, 1)
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+def bucket_ladder(max_batch: int, min_bucket: int = 1) -> List[int]:
+    """The full ladder [min_bucket, 2·min_bucket, ..., max_batch]."""
+    out = []
+    b = max(min_bucket, 1)
+    while b < max_batch:
+        out.append(b)
+        b <<= 1
+    out.append(max_batch)
+    return out
+
+
+class InferenceEngine:
+    """Bucketed inference over a model container.
+
+    ``model`` is a MultiLayerNetwork or ComputationGraph (anything with
+    ``params``/``state``/``_forward`` and the container conf surface).
+    Parameters are read from the model at call time, so the engine stays
+    valid across further ``fit()`` calls — only the program structure is
+    cached, never the weights.
+    """
+
+    def __init__(self, model, max_batch: int = 1024, min_bucket: int = 1):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.min_bucket = int(min_bucket)
+        self.trace_count = 0
+        self._traced_keys = set()
+        self._fwd = None
+        self._lock = threading.Lock()
+        self._is_graph = hasattr(model.conf, "network_inputs")
+        self.warmup_seconds: Optional[float] = None
+
+    # ------------------------------------------------------------- forward
+    def _forward_fn(self):
+        if self._fwd is not None:
+            return self._fwd
+        model = self.model
+
+        if self._is_graph:
+            def fwd(params, state, inputs, mask):
+                self._note_trace(inputs, mask)
+                acts, _, _ = model._forward(params, state, inputs,
+                                            train=False, rng=None)
+                return [acts[n] for n in model.conf.network_outputs]
+        else:
+            def fwd(params, state, inputs, mask):
+                self._note_trace(inputs, mask)
+                act, _, _ = model._forward(params, state, inputs[0],
+                                           train=False, rng=None, mask=mask)
+                return [act]
+
+        self._fwd = jax.jit(fwd)
+        return self._fwd
+
+    def _note_trace(self, inputs, mask):
+        # runs only while jit traces a NEW (shape, dtype, mask-presence)
+        # signature — i.e. exactly once per compiled program
+        key = (tuple((tuple(x.shape), str(x.dtype)) for x in inputs),
+               None if mask is None else (tuple(mask.shape), str(mask.dtype)))
+        self.trace_count += 1
+        self._traced_keys.add(key)
+
+    # ------------------------------------------------------------- padding
+    @staticmethod
+    def _pad_rows(a, b: int):
+        n = a.shape[0]
+        if n == b:
+            return a
+        widths = [(0, b - n)] + [(0, 0)] * (a.ndim - 1)
+        if isinstance(a, np.ndarray):
+            return np.pad(a, widths)
+        return jnp.pad(a, widths)
+
+    def _dispatch(self, inputs: Sequence, mask=None) -> List:
+        """One bucketed device call: pad → run → slice. Returns the list of
+        output device arrays (async — not yet host-read). Batches larger
+        than ``max_batch`` are chunked through the top bucket."""
+        n = inputs[0].shape[0]
+        if n > self.max_batch:
+            pieces = [self._dispatch(
+                [x[i:i + self.max_batch] for x in inputs],
+                None if mask is None else mask[i:i + self.max_batch])
+                for i in range(0, n, self.max_batch)]
+            return [jnp.concatenate([p[j] for p in pieces])
+                    for j in range(len(pieces[0]))]
+        b = bucket_for(n, self.max_batch, self.min_bucket)
+        padded = [self._pad_rows(x, b) for x in inputs]
+        mask_p = None if mask is None else self._pad_rows(mask, b)
+        outs = self._forward_fn()(self.model.params, self.model.state,
+                                  padded, mask_p)
+        return [o[:n] for o in outs]
+
+    # ----------------------------------------------------------- public API
+    def predict(self, x, mask=None):
+        """Bucketed forward. ``x``: one batch array, or a list of input
+        arrays for multi-input graphs; returns device array(s) shaped like
+        the model's own ``output()`` (slicing already applied). The call is
+        async — reading the result to the host is the caller's sync point."""
+        single = not isinstance(x, (list, tuple))
+        inputs = [jnp.asarray(x)] if single else [jnp.asarray(a) for a in x]
+        if mask is not None:
+            mask = jnp.asarray(mask)
+        outs = self._dispatch(inputs, mask)
+        if self._is_graph:
+            return outs[0] if len(outs) == 1 else outs
+        return outs[0]
+
+    def predict_host(self, x, mask=None):
+        """``predict`` + host read; returns np.ndarray (or list of them)."""
+        out = self.predict(x, mask)
+        if isinstance(out, list):
+            return [np.asarray(o) for o in out]
+        return np.asarray(out)
+
+    def predict_stream(self, batches, depth: int = 2):
+        """Pipelined inference over an iterable of batches: keeps up to
+        ``depth`` dispatches in flight so the device executes batch k+1
+        while the host reads batch k's result (the role AsyncDataSetIterator
+        prefetch plays on the input side). Yields host np arrays — one per
+        input batch, in order; multi-output graphs yield lists."""
+        pending = deque()
+
+        def read(out):
+            if isinstance(out, list) and self._is_graph and len(out) > 1:
+                return [np.asarray(o) for o in out]
+            o = out[0] if isinstance(out, list) else out
+            return np.asarray(o)
+
+        for x in batches:
+            pending.append(self.predict(x))
+            while len(pending) >= max(depth, 1):
+                yield read(pending.popleft())
+        while pending:
+            yield read(pending.popleft())
+
+    # -------------------------------------------------------------- warmup
+    def warmup(self, example_shape, dtype=np.float32, max_batch=None,
+               with_mask_len: Optional[int] = None):
+        """Pre-compile the bucket ladder through the persistent compilation
+        cache so the first real request pays ~0 compile time.
+
+        ``example_shape``: per-example feature shape (no batch dim), or a
+        list of shapes for multi-input graphs. ``max_batch`` caps the ladder
+        (default: the engine's max_batch). ``with_mask_len``: also compile
+        the mask-carrying variants for (B, T=with_mask_len) masks.
+        Returns the list of bucket sizes compiled."""
+        from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
+        setup_compile_cache()
+        shapes = (example_shape if isinstance(example_shape, list)
+                  else [example_shape])
+        ladder = bucket_ladder(min(max_batch or self.max_batch,
+                                   self.max_batch), self.min_bucket)
+        t0 = time.perf_counter()
+        for b in ladder:
+            zeros = [jnp.zeros((b,) + tuple(s), dtype) for s in shapes]
+            outs = self._dispatch(zeros)
+            if with_mask_len is not None and not self._is_graph:
+                m = jnp.ones((b, with_mask_len), dtype)
+                outs = self._dispatch(zeros, m)
+        jax.block_until_ready(outs)
+        self.warmup_seconds = time.perf_counter() - t0
+        return ladder
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        from deeplearning4j_tpu.util.compile_cache import cache_stats
+        return {"max_batch": self.max_batch,
+                "bucket_ladder": bucket_ladder(self.max_batch,
+                                               self.min_bucket),
+                "compiled_programs": self.trace_count,
+                "warmup_seconds": self.warmup_seconds,
+                "compile_cache": cache_stats()}

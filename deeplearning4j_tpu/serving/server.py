@@ -1,0 +1,116 @@
+"""HTTP inference endpoint over the micro-batched engine.
+
+Same stdlib ThreadingHTTPServer + JSON/Base64-f32 transport as
+clustering/knn_server.py (the reference's NearestNeighborsServer analog);
+each POST /predict rides the micro-batcher, so concurrent HTTP clients are
+coalesced into shared device calls. Wire format in docs/SERVING.md.
+
+Endpoints:
+  POST /predict  {"ndarray": {shape, data}}          → {"ndarray": ...}
+  POST /warmup   {"input_shape": [...], "max_batch"} → {"buckets": [...]}
+  GET  /stats                                        → engine+batcher stats
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+from deeplearning4j_tpu.clustering.knn_server import (
+    ndarray_from_b64, ndarray_to_b64)
+from deeplearning4j_tpu.serving.batcher import MicroBatcher
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        srv = self.server.inference
+        if urlparse(self.path).path == "/stats":
+            self._json(srv.stats())
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        srv = self.server.inference
+        path = urlparse(self.path).path
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(n).decode())
+        except Exception as e:
+            self._json({"error": f"bad json: {e}"}, 400)
+            return
+        try:
+            if path == "/predict":
+                x = ndarray_from_b64(payload["ndarray"])
+                if x.ndim == 1:
+                    x = x[None, :]
+                    out = srv.batcher.predict(x)[0]
+                else:
+                    out = srv.batcher.predict(x)
+                self._json({"ndarray": ndarray_to_b64(out)})
+            elif path == "/warmup":
+                shape = payload["input_shape"]
+                shapes = ([tuple(s) for s in shape]
+                          if shape and isinstance(shape[0], list)
+                          else tuple(shape))
+                buckets = srv.engine.warmup(
+                    shapes, max_batch=payload.get("max_batch"))
+                self._json({"buckets": buckets,
+                            "seconds": srv.engine.warmup_seconds})
+            else:
+                self._json({"error": "not found"}, 404)
+        except Exception as e:  # noqa: BLE001 — service must answer
+            self._json({"error": str(e)}, 500)
+
+
+class InferenceServer:
+    """Serve a model container over HTTP through bucketed micro-batching.
+
+        srv = InferenceServer(net, port=0).start()
+        out = InferenceClient(f"http://localhost:{srv.port}").predict(x)
+    """
+
+    def __init__(self, model, port: int = 9300, host: str = "127.0.0.1",
+                 max_batch: int = 256, max_latency_ms: float = 2.0,
+                 engine: Optional[InferenceEngine] = None):
+        self.engine = engine or InferenceEngine(model)
+        self.batcher = MicroBatcher(self.engine, max_batch=max_batch,
+                                    max_latency_ms=max_latency_ms)
+        self._port_req = port
+        self._host = host
+        self._httpd = None
+        self.port: Optional[int] = None
+
+    def stats(self) -> dict:
+        return {"engine": self.engine.stats(),
+                "batcher": self.batcher.stats()}
+
+    def start(self) -> "InferenceServer":
+        self.batcher.start()
+        self._httpd = ThreadingHTTPServer((self._host, self._port_req),
+                                          _Handler)
+        self._httpd.inference = self
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.batcher.stop()

@@ -28,3 +28,19 @@ def setup_compile_cache(cache_dir=None) -> str:
     except Exception:
         pass
     return str(d)
+
+
+def cache_stats(cache_dir=None) -> dict:
+    """Entry count + total bytes of the persistent cache directory (the
+    serving /stats surface: lets an operator confirm a warmed process will
+    really serve its first request compile-free). Safe before setup — an
+    absent directory reports zero entries."""
+    d = Path(cache_dir or os.environ.get("DL4JTPU_JAX_CACHE")
+             or Path(__file__).resolve().parents[2] / ".jax_cache")
+    entries = bytes_ = 0
+    if d.is_dir():
+        for p in d.rglob("*"):
+            if p.is_file():
+                entries += 1
+                bytes_ += p.stat().st_size
+    return {"dir": str(d), "entries": entries, "bytes": bytes_}

@@ -15,8 +15,9 @@ def check_remat_mode(mode):
     """Fail fast on an invalid mode (builder/zoo entry points call this so
     a typo surfaces at configuration time, not at the first train step)."""
     if mode not in _MODES:
-        raise ValueError(f"unknown remat mode {mode!r} "
-                         "(False | True | 'full' | 'save_convs')")
+        raise ValueError(
+            f"unknown remat mode {mode!r} "
+            "(False | True | 'full' | 'save_convs' | 'selective')")
     return mode
 
 

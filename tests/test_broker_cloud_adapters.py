@@ -3,6 +3,9 @@ deeplearning4j-aws S3 reader/uploader), contract-tested against the
 in-process fakes — the optional real backends (kafka-python, boto3) share
 the exact same protocol surface."""
 
+import importlib.util
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -10,6 +13,8 @@ from deeplearning4j_tpu.data.kafka import (
     InMemoryBroker, NDArrayPublisher, NDArrayPubSubRoute, default_client)
 from deeplearning4j_tpu.scaleout.s3 import (
     LocalFileStore, S3Downloader, S3Uploader)
+
+_HAS_KAFKA = importlib.util.find_spec("kafka") is not None
 
 
 def test_kafka_route_end_to_end_records_to_datasets():
@@ -62,9 +67,20 @@ def test_kafka_route_trains_a_net():
     assert np.isfinite(net.get_score())
 
 
+@pytest.mark.skipif(_HAS_KAFKA, reason="kafka-python installed: "
+                    "default_client would attempt a real broker connection")
 def test_default_client_names_optional_dependency():
     with pytest.raises(ImportError, match="kafka-python"):
         default_client()
+
+
+@pytest.mark.skipif(not _HAS_KAFKA, reason="needs kafka-python")
+def test_default_client_wraps_broker_connection_errors():
+    """Package present but no broker: the error must stay actionable (name
+    the servers tried and the InMemoryBroker escape hatch), not surface as
+    a bare NoBrokersAvailable from kafka internals."""
+    with pytest.raises(ConnectionError, match="InMemoryBroker"):
+        default_client("127.0.0.1:1")       # nothing listens on port 1
 
 
 def test_s3_contract_roundtrip(tmp_path):
@@ -94,6 +110,22 @@ def test_s3_upload_dir_and_prefix_download(tmp_path):
                                               tmp_path / "fetched")
     assert sorted(p.name for p in got) == ["a.txt", "b.txt"]
     assert (tmp_path / "fetched" / "sub" / "b.txt").read_text() == "b"
+
+
+def test_s3_download_prefix_strips_only_at_slash_boundary(tmp_path):
+    """Regression: prefix ``data`` also char-matches key ``database/x.txt``;
+    that key must keep its full relative path, not be mangled to
+    ``base/x.txt``."""
+    store = LocalFileStore(tmp_path / "store")
+    for key, text in (("data/a.txt", "a"), ("database/x.txt", "x")):
+        src = tmp_path / Path(key).name
+        src.write_text(text)
+        S3Uploader(store).upload_file(src, "bk", key)
+    got = S3Downloader(store).download_prefix("bk", "data",
+                                              tmp_path / "fetched")
+    assert sorted(p.relative_to(tmp_path / "fetched").as_posix()
+                  for p in got) == ["a.txt", "database/x.txt"]
+    assert (tmp_path / "fetched" / "database" / "x.txt").read_text() == "x"
 
 
 def test_s3_download_dataset_lands_in_fetcher_cache(tmp_path, monkeypatch):

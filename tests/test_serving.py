@@ -1,0 +1,269 @@
+"""Serving engine: shape-bucketed inference + micro-batcher + HTTP endpoint.
+
+The load-bearing claims pinned here:
+- the bucketed ``output()`` fast path is BITWISE-equal to the exact-shape
+  forward for every tested batch size (padding is numerics-neutral because
+  inference computes each output row from its own input row alone);
+- a mixed-size request stream (sizes 1..64) compiles at most
+  ⌈log2(64)⌉+1 programs where the seed path compiled once per distinct
+  size (counted via the engine's trace hook);
+- the micro-batcher answers every concurrent request with its own slice
+  while merging them into fewer device calls;
+- the HTTP endpoint round-trips the knn_server-style Base64 f32 wire
+  format, and ``/warmup`` leaves the process able to serve the whole
+  ladder without another trace.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (NeuralNetConfiguration, MultiLayerNetwork,
+                                ComputationGraph)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, LSTM, OutputLayer,
+    RnnOutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.serving import (
+    InferenceClient, InferenceEngine, InferenceServer, MicroBatcher,
+    bucket_for, bucket_ladder)
+
+
+def _mlp():
+    conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------------ ladder helpers
+
+def test_bucket_ladder_and_bucket_for():
+    assert bucket_ladder(64) == [1, 2, 4, 8, 16, 32, 64]
+    assert bucket_ladder(64, min_bucket=8) == [8, 16, 32, 64]
+    assert [bucket_for(n, 64) for n in (1, 2, 3, 5, 8, 9, 64)] \
+        == [1, 2, 4, 8, 8, 16, 64]
+    assert bucket_for(100, 64) == 64          # clamped to the top bucket
+    with pytest.raises(ValueError):
+        bucket_for(0, 64)
+
+
+# ----------------------------------------------------------- bitwise parity
+
+def test_bucketed_output_bitwise_equal_mlp():
+    net = _mlp()
+    rs = np.random.RandomState(0)
+    for n in (1, 3, 5, 7, 11, 13, 27):        # none of these is a bucket
+        x = rs.rand(n, 4).astype(np.float32)
+        bucketed = np.asarray(net.output(x))
+        direct = np.asarray(net.output(x, bucketed=False))
+        assert bucketed.shape == (n, 3)
+        assert np.array_equal(bucketed, direct), f"batch {n} diverged"
+
+
+def test_bucketed_output_bitwise_equal_conv_bn():
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=3,
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=2, stride=2))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(1)
+    # one fit so BN running stats are non-trivial at inference
+    net.fit(rs.rand(8, 12, 12, 1).astype(np.float32),
+            np.eye(5, dtype=np.float32)[rs.randint(0, 5, 8)])
+    for n in (1, 5, 9, 17):
+        x = rs.rand(n, 12, 12, 1).astype(np.float32)
+        assert np.array_equal(np.asarray(net.output(x)),
+                              np.asarray(net.output(x, bucketed=False)))
+
+
+def test_bucketed_output_bitwise_equal_lstm_with_mask():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+            .list()
+            .layer(LSTM(n_in=6, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(2)
+    x = rs.rand(5, 10, 6).astype(np.float32)
+    m = (rs.rand(5, 10) > 0.3).astype(np.float32)
+    assert np.array_equal(np.asarray(net.output(x, mask=m)),
+                          np.asarray(net.output(x, mask=m, bucketed=False)))
+
+
+def test_bucketed_output_computation_graph():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+            .weight_init("xavier").graph_builder()
+            .add_inputs("in").set_input_types(InputType.feed_forward(4))
+            .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out").build())
+    cg = ComputationGraph(conf).init()
+    rs = np.random.RandomState(4)
+    for n in (1, 5, 9):
+        x = rs.rand(n, 4).astype(np.float32)
+        assert np.array_equal(np.asarray(cg.output(x)),
+                              np.asarray(cg.output(x, bucketed=False)))
+    # 3 distinct sizes → at most 3 bucket programs (1, 8, 16)
+    assert cg.serving_engine().trace_count <= 3
+
+
+# --------------------------------------------------------- compile counting
+
+def test_mixed_size_stream_compiles_at_most_the_ladder():
+    """Sizes 1..64 through the bucketed path: ≤ ⌈log2(64)⌉+1 programs where
+    the exact-shape seed path would compile 64."""
+    net = _mlp()
+    eng = net.serving_engine()
+    rs = np.random.RandomState(5)
+    for n in range(1, 65):
+        out = np.asarray(net.output(rs.rand(n, 4).astype(np.float32)))
+        assert out.shape == (n, 3)
+    assert eng.trace_count <= 7, \
+        f"{eng.trace_count} programs for sizes 1..64 (ladder allows 7)"
+
+
+def test_oversize_batch_chunks_through_top_bucket():
+    net = _mlp()
+    eng = net.serving_engine(max_batch=8)
+    assert eng.max_batch == 8
+    rs = np.random.RandomState(6)
+    x = rs.rand(21, 4).astype(np.float32)           # 8 + 8 + 5→pad 8
+    assert np.array_equal(np.asarray(eng.predict(x)),
+                          np.asarray(net.output(x, bucketed=False)))
+    assert eng.trace_count <= 2                     # bucket 8 (+ bucket 8 pad)
+
+
+def test_warmup_precompiles_the_ladder():
+    net = _mlp()
+    eng = net.serving_engine()
+    buckets = eng.warmup((4,), max_batch=16)
+    assert buckets == [1, 2, 4, 8, 16]
+    traces_after_warmup = eng.trace_count
+    rs = np.random.RandomState(7)
+    for n in (1, 3, 6, 11, 16):
+        net.output(rs.rand(n, 4).astype(np.float32))
+    assert eng.trace_count == traces_after_warmup   # no new programs
+    assert eng.warmup_seconds is not None
+    stats = eng.stats()
+    assert stats["compiled_programs"] == traces_after_warmup
+
+
+# ------------------------------------------------------- pipelined evaluate
+
+def test_evaluate_pipelined_matches_per_batch_eval():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    net = _mlp()
+    rs = np.random.RandomState(8)
+    batches = [DataSet(rs.rand(n, 4).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)])
+               for n in (5, 3, 8, 1, 6)]
+    ev = net.evaluate(batches)
+    ref = Evaluation()
+    for ds in batches:
+        ref.eval(ds.labels,
+                 np.asarray(net.output(ds.features, bucketed=False)))
+    assert ev.accuracy() == ref.accuracy()
+    assert np.array_equal(ev.confusion, ref.confusion)
+
+
+# ------------------------------------------------------------ micro-batcher
+
+def test_micro_batcher_coalesces_and_demuxes():
+    net = _mlp()
+    eng = net.serving_engine()
+    eng.warmup((4,), max_batch=64)
+    mb = MicroBatcher(eng, max_batch=64, max_latency_ms=20.0).start()
+    try:
+        rs = np.random.RandomState(9)
+        reqs = [rs.rand(1 + i % 5, 4).astype(np.float32) for i in range(24)]
+        futs = [mb.submit(x) for x in reqs]
+        for x, fut in zip(reqs, futs):
+            got = fut.result(timeout=30)
+            assert np.array_equal(got,
+                                  np.asarray(net.output(x, bucketed=False)))
+        stats = mb.stats()
+        assert stats["requests"] == 24
+        assert stats["device_calls"] < 24       # coalescing actually merged
+    finally:
+        mb.stop()
+
+
+def test_micro_batcher_stop_fails_pending_futures():
+    net = _mlp()
+    mb = MicroBatcher(net.serving_engine(), max_latency_ms=1.0)
+    mb.start()
+    mb.stop()
+    # queue drained; a fresh submit after stop restarts the worker
+    fut = mb.submit(np.zeros((2, 4), np.float32))
+    assert fut.result(timeout=30).shape == (2, 3)
+    mb.stop()
+
+
+# ------------------------------------------------------------- HTTP serving
+
+def test_http_server_roundtrip_warmup_and_stats():
+    net = _mlp()
+    srv = InferenceServer(net, port=0, max_latency_ms=5.0).start()
+    try:
+        cli = InferenceClient(f"http://127.0.0.1:{srv.port}")
+        warm = cli.warmup([4], max_batch=8)
+        assert warm["buckets"] == [1, 2, 4, 8]
+        rs = np.random.RandomState(10)
+        x = rs.rand(5, 4).astype(np.float32)
+        assert np.array_equal(cli.predict(x),
+                              np.asarray(net.output(x, bucketed=False)))
+        v = cli.predict(x[0])                   # 1-D vector: batch of 1
+        assert v.shape == (3,)
+        assert np.array_equal(v, np.asarray(net.output(x[:1]))[0])
+        stats = cli.stats()
+        assert stats["engine"]["compiled_programs"] >= 4
+        assert stats["batcher"]["requests"] >= 2
+        # malformed payload comes back as an error reply, not a hung socket
+        with pytest.raises(RuntimeError, match="reshape|bad json|decode"):
+            cli._request("/predict", {"ndarray": {"shape": [2], "data": "!"}})
+    finally:
+        srv.stop()
+
+
+def test_http_concurrent_clients_share_device_calls():
+    net = _mlp()
+    srv = InferenceServer(net, port=0, max_batch=64,
+                          max_latency_ms=25.0).start()
+    try:
+        cli = InferenceClient(f"http://127.0.0.1:{srv.port}")
+        cli.warmup([4], max_batch=64)
+        rs = np.random.RandomState(11)
+        results = {}
+
+        def call(i):
+            x = rs.rand(1 + i % 3, 4).astype(np.float32)
+            results[i] = (x, cli.predict(x))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 12
+        for x, out in results.values():
+            assert np.array_equal(out,
+                                  np.asarray(net.output(x, bucketed=False)))
+        assert srv.batcher.stats()["device_calls"] < 12
+    finally:
+        srv.stop()
