@@ -7,6 +7,7 @@ from deeplearning4j_tpu.data.iterators import (
 from deeplearning4j_tpu.data.streaming import (
     StreamingDataSetIterator, encode_record, decode_record,
 )
+from deeplearning4j_tpu.data.prefetcher import DevicePrefetcher
 from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
 )
@@ -16,6 +17,6 @@ __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "ExistingDataSetIterator", "AsyncDataSetIterator",
     "AsyncMultiDataSetIterator", "MultipleEpochsIterator",
-    "JointParallelDataSetIterator", "InequalityHandling",
+    "JointParallelDataSetIterator", "InequalityHandling", "DevicePrefetcher",
     "NormalizerStandardize", "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
 ]

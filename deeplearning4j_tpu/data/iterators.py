@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -128,18 +129,47 @@ class ExistingDataSetIterator(DataSetIterator):
 
 
 class AsyncDataSetIterator(DataSetIterator):
-    """Background-thread prefetch wrapper
-    (parity: AsyncDataSetIterator, queue size = prefetch buffer)."""
+    """Background prefetch + parallel-ETL wrapper.
+
+    At ``workers=1`` this is the reference's AsyncDataSetIterator (one
+    prefetch thread, queue size = prefetch buffer). At ``workers=N`` it
+    plays the reference's ParallelDataSetIterator role: N threads pull
+    batches from the base (serialized by a lock — the pull is the cheap
+    part) and run the expensive per-batch work concurrently — the base's
+    host-side pre-processor and the optional ``transform`` callable
+    (decode/augment, e.g. bytes → DataSet) both execute inside the
+    workers, so ETL overlaps device compute AND itself.
+
+    ``ordered=True`` (default) emits batches in exact base order — training
+    through it is bitwise-identical to training through the base directly.
+    ``ordered=False`` emits batches as workers finish them (lower latency
+    jitter, order nondeterministic). The queue stays bounded either way:
+    backpressure reaches the base when the consumer falls behind.
+
+    Worker errors propagate to the consumer: every in-order batch decoded
+    before the failure is delivered, then the error raises from
+    ``__next__``. ``reset()``/``_shutdown()`` stop workers promptly even
+    when they are blocked on a full queue (the drain loop runs until every
+    worker has exited, not just once)."""
 
     _SENTINEL = object()
 
-    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+    def __init__(self, base: DataSetIterator, queue_size: int = 4,
+                 workers: int = 1, ordered: bool = True, transform=None):
+        if workers < 1:
+            raise ValueError(f"workers must be ≥ 1, got {workers}")
         self.base = base
         self.queue_size = queue_size
+        self.workers = int(workers)
+        self.ordered = ordered
+        self.transform = transform
         self._q = None
-        self._thread = None
+        self._threads = []
         self._error = None
         self._stop = None
+        self._stash = {}
+        self._next_seq = 0
+        self._done = False
 
     def reset(self):
         self._shutdown()
@@ -147,35 +177,59 @@ class AsyncDataSetIterator(DataSetIterator):
         self._q = queue.Queue(maxsize=self.queue_size)
         self._error = None
         self._stop = stop = threading.Event()
+        self._stash = {}
+        self._next_seq = 0
+        self._done = False
         q = self._q
+        pull_lock = threading.Lock()   # base iterators are not thread-safe
+        state_lock = threading.Lock()
+        shared = {"seq": 0, "live": self.workers}
 
         def worker():
             try:
                 while not stop.is_set():
-                    try:
-                        item = next(self.base)
-                    except StopIteration:
-                        break
+                    with pull_lock:
+                        if stop.is_set():
+                            break
+                        try:
+                            item = next(self.base)
+                        except StopIteration:
+                            break
+                        seq = shared["seq"]
+                        shared["seq"] += 1
+                    # the parallel part: decode/augment outside the lock
+                    if self.transform is not None:
+                        item = self.transform(item)
                     while not stop.is_set():
                         try:
-                            q.put(item, timeout=0.1)
+                            q.put((seq, item), timeout=0.1)
                             break
                         except queue.Full:
                             continue
             except Exception as e:  # propagate ETL errors to consumer
-                self._error = e
+                with state_lock:
+                    if self._error is None:
+                        self._error = e
             finally:
-                try:
-                    q.put_nowait(self._SENTINEL)
-                except queue.Full:
-                    pass
+                with state_lock:
+                    shared["live"] -= 1
+                    last = shared["live"] == 0
+                if last:
+                    while not stop.is_set():
+                        try:
+                            q.put(self._SENTINEL, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
 
-        self._thread = threading.Thread(target=worker, daemon=True)
-        self._thread.start()
+        self._threads = [threading.Thread(target=worker, daemon=True)
+                         for _ in range(self.workers)]
+        for t in self._threads:
+            t.start()
         self._consumed = False
 
     def __iter__(self):
-        # only restart the worker if this wrapper has already handed out
+        # only restart the workers if this wrapper has already handed out
         # items: fit() calls reset() and THEN iterates, and a second reset
         # here would discard prefetched batches — destructive for
         # forward-only bases (StreamingDataSetIterator)
@@ -188,35 +242,62 @@ class AsyncDataSetIterator(DataSetIterator):
             self.reset()
         self._consumed = True
         while True:
+            if self.ordered and self._next_seq in self._stash:
+                item = self._stash.pop(self._next_seq)
+                self._next_seq += 1
+                # honor a processor set on THIS wrapper (base applies its own)
+                return self._emit(item)
+            if self._done:
+                # every contiguous in-order batch was already delivered by
+                # the stash pop above; a remaining stash means a worker
+                # error left a gap in the sequence — raise it here
+                if self._error is not None:
+                    raise self._error
+                if self._stash:         # defensive: gap without an error
+                    seq = min(self._stash)
+                    item = self._stash.pop(seq)
+                    self._next_seq = seq + 1
+                    return self._emit(item)
+                raise StopIteration
             try:
-                item = self._q.get(timeout=0.5)
-                break
+                got = self._q.get(timeout=0.5)
             except queue.Empty:
-                # worker may have died with a full queue and dropped the
+                # workers may have died with a full queue and dropped the
                 # sentinel; don't block forever
-                if self._thread is None or not self._thread.is_alive():
-                    if self._error is not None:
-                        raise self._error
-                    raise StopIteration
-        if item is self._SENTINEL:
-            if self._error is not None:
-                raise self._error
-            raise StopIteration
-        # honor a processor set on THIS wrapper (the base applies its own)
-        return self._emit(item)
+                if not any(t.is_alive() for t in self._threads):
+                    self._done = True
+                continue
+            if got is self._SENTINEL:
+                self._done = True
+                continue
+            seq, item = got
+            if not self.ordered:
+                return self._emit(item)
+            self._stash[seq] = item
 
     def _shutdown(self):
-        if self._thread is not None and self._thread.is_alive():
+        threads = [t for t in self._threads if t.is_alive()]
+        if threads:
             self._stop.set()
-            try:
-                while True:
-                    self._q.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=2.0)
-        self._thread = None
+            # workers blocked in q.put free a slot only when we drain; one
+            # drain pass is NOT enough — a worker can refill the slot before
+            # observing the stop flag. Alternate drain/join until every
+            # worker has exited (each put/get timeout is 0.1 s, so this
+            # converges in a bounded number of rounds).
+            deadline = time.monotonic() + 10.0
+            while threads and time.monotonic() < deadline:
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                for t in threads:
+                    t.join(timeout=0.05)
+                threads = [t for t in threads if t.is_alive()]
+        self._threads = []
         self._q = None
         self._stop = None
+        self._stash = {}
 
 
 # The async prefetch wrapper is payload-agnostic (it just pulls next(base)

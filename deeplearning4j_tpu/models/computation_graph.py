@@ -308,8 +308,13 @@ class ComputationGraph:
             lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
-    def fit(self, data, labels=None, epochs=1):
-        """fit(inputs, labels) | fit(MultiDataSet/DataSet) | fit(iterator)."""
+    def fit(self, data, labels=None, epochs=1, prefetch=None):
+        """fit(inputs, labels) | fit(MultiDataSet/DataSet) | fit(iterator).
+
+        ``prefetch``: device-resident prefetch depth for the streamed path
+        (see data/prefetcher.py and MultiLayerNetwork.fit); ``None`` uses
+        the class default ``prefetch_depth``, ``0`` disables. Per-stage
+        timing lands in ``self.last_pipeline_stats``."""
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
         if labels is not None:
             return self._fit_batch(MultiDataSet(
@@ -322,7 +327,7 @@ class ComputationGraph:
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
-            self._fit_stream(data)
+            self._fit_stream(data, prefetch=prefetch)
             self.epoch += 1
         return self
 
@@ -331,13 +336,17 @@ class ComputationGraph:
     _CHUNK_MAX_STEPS = 64
     _CHUNK_MAX_BYTES = 256 << 20
 
-    def _fit_stream(self, data):
-        from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+    # see MultiLayerNetwork: device-resident prefetch depth for the
+    # streamed fit/eval path, and the last epoch's per-stage timing
+    prefetch_depth = 2
+    last_pipeline_stats = None
+
+    def _resolve_device_pp(self, data):
+        """(dev_fn, host_pp) — see MultiLayerNetwork._resolve_device_pp;
+        a device_side processor with no device transform falls back to
+        host application."""
         from deeplearning4j_tpu.data.iterators import resolve_pre_processor
 
-        # device-side normalizer (see data/normalizers.py); a device_side
-        # processor with no device transform falls back to host application
-        # (same rule as MultiLayerNetwork._fit_stream)
         pp = resolve_pre_processor(data)
         dev_fn = host_pp = None
         if pp is not None and getattr(pp, "device_side", False):
@@ -346,15 +355,15 @@ class ComputationGraph:
                 dev_fn = jax.jit(f)
             else:
                 host_pp = pp
+        return dev_fn, host_pp
 
-        def dev_mds(m):
-            if dev_fn is None:
-                return m
-            return MultiDataSet(
-                features=[dev_fn(jnp.asarray(np.asarray(ff)))
-                          for ff in m.features],
-                labels=m.labels, features_masks=m.features_masks,
-                labels_masks=m.labels_masks)
+    def _stream_chunks(self, data, host_pp, timer):
+        """Host-side chunk assembly (see MultiLayerNetwork._stream_chunks):
+        yields ``("chunk", (xs_list, ys_list))`` stacked host blocks or
+        ``("batch", MultiDataSet)`` fallbacks, in base order — chunk
+        boundaries do not depend on prefetch depth, so the training math
+        is bitwise-identical with prefetch on or off."""
+        from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 
         chunkable = (getattr(self.conf, "backprop_type", "standard")
                      != "tbptt")
@@ -362,54 +371,112 @@ class ComputationGraph:
 
         def flush():
             nonlocal buf, shape
-            if not buf:
-                return
+            out = None
             if len(buf) == 1:
-                self._fit_batch(dev_mds(buf[0]))
-            else:
-                xs = [np.stack([np.asarray(m.features[i]) for m in buf])
-                      for i in range(len(buf[0].features))]
-                if dev_fn is not None:
-                    xs = [dev_fn(jnp.asarray(a)) for a in xs]
-                ys = [np.stack([np.asarray(m.labels[i]) for m in buf])
-                      for i in range(len(buf[0].labels))]
-                self.fit_scan(xs, ys)
+                out = ("batch", buf[0])
+            elif buf:
+                with timer.stage("stack"):
+                    xs = [np.stack([np.asarray(m.features[i]) for m in buf])
+                          for i in range(len(buf[0].features))]
+                    ys = [np.stack([np.asarray(m.labels[i]) for m in buf])
+                          for i in range(len(buf[0].labels))]
+                    out = ("chunk", (xs, ys))
             buf, shape = [], None
+            return out
 
-        for batch in data:
+        it = iter(data)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            timer.add("fetch", time.perf_counter() - t0)
             if isinstance(batch, DataSet):
                 batch = batch.to_multi()
             elif not isinstance(batch, MultiDataSet):
                 batch = MultiDataSet(features=[batch[0]], labels=[batch[1]])
             if host_pp is not None:
-                batch = MultiDataSet(
-                    features=[host_pp.transform_features(np.asarray(f))
-                              for f in batch.features],
-                    labels=batch.labels, features_masks=batch.features_masks,
-                    labels_masks=batch.labels_masks)
+                with timer.stage("decode"):
+                    batch = MultiDataSet(
+                        features=[host_pp.transform_features(np.asarray(f))
+                                  for f in batch.features],
+                        labels=batch.labels,
+                        features_masks=batch.features_masks,
+                        labels_masks=batch.labels_masks)
             has_mask = (
                 (batch.features_masks
                  and any(m is not None for m in batch.features_masks))
                 or (batch.labels_masks
                     and any(m is not None for m in batch.labels_masks)))
             if not chunkable or has_mask:
-                flush()
-                # fallback batches must be normalized too (the iterator
-                # emitted them raw for a device_side processor)
-                self._fit_batch(dev_mds(batch))
+                out = flush()
+                if out is not None:
+                    yield out
+                yield ("batch", batch)
                 continue
             key = (tuple(np.asarray(f).shape for f in batch.features),
                    tuple(np.asarray(l).shape for l in batch.labels))
             if shape is not None and key != shape:
-                flush()
+                out = flush()
+                if out is not None:
+                    yield out
             shape = key
             buf.append(batch)
             per = (sum(np.asarray(f).nbytes for f in batch.features)
                    + sum(np.asarray(l).nbytes for l in batch.labels))
             if len(buf) >= max(1, min(self._CHUNK_MAX_STEPS,
                                       self._CHUNK_MAX_BYTES // max(1, per))):
-                flush()
-        flush()
+                yield flush()
+        out = flush()
+        if out is not None:
+            yield out
+
+    def _fit_stream(self, data, prefetch=None):
+        """One epoch: host chunk assembly → device-resident prefetch →
+        compiled steps (see MultiLayerNetwork._fit_stream for the overlap
+        model and stall accounting)."""
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        from deeplearning4j_tpu.data.prefetcher import DevicePrefetcher
+        from deeplearning4j_tpu.util.timing import PipelineTimer
+
+        dev_fn, host_pp = self._resolve_device_pp(data)
+
+        def dev_mds(m):
+            if dev_fn is None:
+                return m
+            return MultiDataSet(
+                features=[dev_fn(jnp.asarray(ff)) for ff in m.features],
+                labels=m.labels, features_masks=m.features_masks,
+                labels_masks=m.labels_masks)
+
+        depth = self.prefetch_depth if prefetch is None else int(prefetch)
+        timer = PipelineTimer()
+        stream = self._stream_chunks(data, host_pp, timer)
+        if depth > 0:
+            stream = DevicePrefetcher(stream, depth=depth, timer=timer)
+        it = iter(stream)
+        timer.start()
+        while True:
+            with timer.stage("wait"):
+                try:
+                    kind, payload = next(it)
+                except StopIteration:
+                    break
+            with timer.stage("step"):
+                if kind == "chunk":
+                    xs, ys = payload
+                    xs = [jnp.asarray(a) for a in xs]
+                    if dev_fn is not None:
+                        xs = [dev_fn(a) for a in xs]
+                    self.fit_scan(xs, ys)
+                else:
+                    # fallback batches must be normalized too (the
+                    # iterator emitted them raw for a device_side
+                    # processor)
+                    self._fit_batch(dev_mds(payload))
+        timer.stop()
+        self.last_pipeline_stats = timer.summary()
 
     def _fit_batch(self, mds):
         inputs = [jnp.asarray(f) for f in mds.features]
@@ -602,28 +669,48 @@ class ComputationGraph:
     def evaluate(self, data):
         """First-output classification eval, dispatched through the
         bucketed engine with the host read pipelined one batch behind the
-        device (see MultiLayerNetwork._eval_stream)."""
+        device (see MultiLayerNetwork._eval_stream). Features are staged
+        on device ahead of the engine and a ``device_side`` pre-processor
+        on the iterator chain runs on chip here too — train/eval parity."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+        from deeplearning4j_tpu.data.prefetcher import DevicePrefetcher
+        from deeplearning4j_tpu.util.timing import PipelineTimer
+
         ev = Evaluation()
+        dev_fn, host_pp = self._resolve_device_pp(data)
         if isinstance(data, (DataSet, MultiDataSet)):
             data = [data]
         elif hasattr(data, "reset"):
             data.reset()
         eng = self.serving_engine()
         labels = []
+        timer = PipelineTimer()
 
         def feats():
             for ds in data:
                 if isinstance(ds, DataSet):
                     ds = ds.to_multi()
+                if host_pp is not None:
+                    ds = MultiDataSet(
+                        features=[host_pp.transform_features(np.asarray(f))
+                                  for f in ds.features],
+                        labels=ds.labels, features_masks=ds.features_masks,
+                        labels_masks=ds.labels_masks)
                 labels.append(ds.labels[0])
                 yield [jnp.asarray(f) for f in ds.features]
 
-        for i, out in enumerate(eng.predict_stream(feats())):
+        dev_tx = (None if dev_fn is None
+                  else (lambda fs: [dev_fn(f) for f in fs]))
+        staged = DevicePrefetcher(feats(), depth=max(1, self.prefetch_depth),
+                                  transform=dev_tx, timer=timer)
+        timer.start()
+        for i, out in enumerate(eng.predict_stream(staged)):
             if isinstance(out, list):
                 out = out[0]
             ev.eval(np.asarray(labels[i]), out)
+        timer.stop()
+        self.last_pipeline_stats = timer.summary()
         return ev
 
     # ------------------------------------------------------------- utilities

@@ -294,7 +294,7 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
-    def fit(self, data, labels=None, epochs=1):
+    def fit(self, data, labels=None, epochs=1, prefetch=None):
         """fit(x, y) | fit(DataSet) | fit(iterator, epochs=N)
         (parity: MultiLayerNetwork.fit :1156).
 
@@ -308,7 +308,14 @@ class MultiLayerNetwork:
         identical (both fold the iteration index into the seed); score
         listeners fire once per chunk instead of once per iteration.
         Masked, tBPTT, or shape-changing batches fall back to single-step
-        fits transparently."""
+        fits transparently.
+
+        ``prefetch``: device-resident prefetch depth for the streamed path
+        (see data/prefetcher.py) — staged work items are device_put ahead
+        of consumption so the H2D transfer of chunk k+1 overlaps the step
+        for chunk k. ``None`` uses the class default ``prefetch_depth``;
+        ``0`` disables (naive path — same math, no overlap). Per-stage
+        timing for the last epoch lands in ``self.last_pipeline_stats``."""
         from deeplearning4j_tpu.data.dataset import DataSet
 
         if labels is not None:
@@ -318,7 +325,7 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
-            self._fit_stream(data)
+            self._fit_stream(data, prefetch=prefetch)
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
@@ -334,16 +341,23 @@ class MultiLayerNetwork:
         return max(1, min(self._CHUNK_MAX_STEPS,
                           self._CHUNK_MAX_BYTES // max(1, per)))
 
-    def _fit_stream(self, data):
-        """One epoch over an iterator, chunking runs of scan-able batches.
-        While the device executes chunk k (async dispatch), the host is
-        already pulling and stacking chunk k+1 — the AsyncDataSetIterator
-        prefetch role, device-side."""
-        from deeplearning4j_tpu.data.dataset import DataSet
+    # device-resident prefetch depth for the streamed fit/eval path: work
+    # items are device_put this many batches ahead of consumption so the
+    # H2D copy of item k+1 overlaps the compiled step for item k
+    # (data/prefetcher.py). 0 = naive path (same math, no overlap).
+    prefetch_depth = 2
+    # per-stage timing summary of the last streamed fit/eval epoch
+    last_pipeline_stats = None
+
+    def _resolve_device_pp(self, data):
+        """Split a ``device_side`` pre-processor off the iterator chain:
+        returns (dev_fn, host_pp). ``dev_fn`` is the jitted on-chip
+        transform (raw — typically uint8 — batches travel host->device and
+        the f32 cast/scale runs on chip, see data/normalizers.py);
+        ``host_pp`` is the fallback when the transform is not expressible
+        device-side (the iterator still emitted the batch raw)."""
         from deeplearning4j_tpu.data.iterators import resolve_pre_processor
 
-        # device-side normalizer (see data/normalizers.py): raw — typically
-        # uint8 — batches travel host->device, the transform runs on chip
         pp = resolve_pre_processor(data)
         dev_fn = host_pp = None
         if pp is not None and getattr(pp, "device_side", False):
@@ -352,51 +366,113 @@ class MultiLayerNetwork:
                 dev_fn = jax.jit(f)
             else:
                 host_pp = pp      # device-side requested but not expressible
+        return dev_fn, host_pp
+
+    def _stream_chunks(self, data, host_pp, timer):
+        """Host-side stage of the streamed fit pipeline: pull batches,
+        stack runs of mask-free same-shape batches into scan chunks.
+        Yields ``("chunk", (xs, ys))`` stacked host blocks (np arrays) or
+        ``("batch", DataSet)`` fallbacks, in base-iterator order — the
+        chunk boundaries do not depend on prefetch depth, so the training
+        math is bitwise-identical with prefetch on or off."""
+        from deeplearning4j_tpu.data.dataset import DataSet
 
         chunkable = self.conf.backprop_type != "tbptt"
         buf, shape = [], None
 
         def flush():
             nonlocal buf, shape
-            if not buf:
-                return
+            out = None
             if len(buf) == 1:
-                self._fit_batch(self._apply_dev_pp(buf[0], dev_fn))
-            else:
-                xs = jnp.asarray(
-                    np.stack([np.asarray(d.features) for d in buf]))
-                if dev_fn is not None:
-                    xs = dev_fn(xs)
-                self.fit_scan(xs,
-                              np.stack([np.asarray(d.labels) for d in buf]))
+                out = ("batch", buf[0])
+            elif buf:
+                with timer.stage("stack"):
+                    out = ("chunk", (
+                        np.stack([np.asarray(d.features) for d in buf]),
+                        np.stack([np.asarray(d.labels) for d in buf])))
             buf, shape = [], None
+            return out
 
-        for batch in data:
+        it = iter(data)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            timer.add("fetch", time.perf_counter() - t0)
             ds = batch if isinstance(batch, DataSet) else DataSet(*batch)
             if host_pp is not None:
-                ds = host_pp.pre_process(ds)
+                with timer.stage("decode"):
+                    ds = host_pp.pre_process(ds)
             if (not chunkable or ds.features_mask is not None
                     or ds.labels_mask is not None):
-                flush()
-                # the fallback path must normalize too — the iterator
-                # intentionally emitted this batch raw for a device_side pp
-                self._fit_batch(self._apply_dev_pp(ds, dev_fn))
+                out = flush()
+                if out is not None:
+                    yield out
+                yield ("batch", ds)
                 continue
             key = (ds.features.shape, ds.labels.shape)
             if shape is not None and key != shape:
-                flush()
+                out = flush()
+                if out is not None:
+                    yield out
             shape = key
             buf.append(ds)
             if len(buf) >= self._chunk_len(ds):
-                flush()
-        flush()
+                yield flush()
+        out = flush()
+        if out is not None:
+            yield out
+
+    def _fit_stream(self, data, prefetch=None):
+        """One epoch over an iterator: host chunk assembly → device-resident
+        prefetch → compiled steps. While the device executes chunk k, the
+        prefetcher has already dispatched the H2D copy of chunk k+1 and the
+        host is stacking chunk k+2 — the three pipeline stages overlap
+        (the AsyncDataSetIterator adds a fourth: parallel decode).
+
+        Per-stage timing lands in ``self.last_pipeline_stats``; its
+        ``host_stall_frac`` is the fraction of epoch wall time the consumer
+        loop spent blocked waiting on data."""
+        from deeplearning4j_tpu.data.prefetcher import DevicePrefetcher
+        from deeplearning4j_tpu.util.timing import PipelineTimer
+
+        dev_fn, host_pp = self._resolve_device_pp(data)
+        depth = self.prefetch_depth if prefetch is None else int(prefetch)
+        timer = PipelineTimer()
+        stream = self._stream_chunks(data, host_pp, timer)
+        if depth > 0:
+            stream = DevicePrefetcher(stream, depth=depth, timer=timer)
+        it = iter(stream)
+        timer.start()
+        while True:
+            with timer.stage("wait"):
+                try:
+                    kind, payload = next(it)
+                except StopIteration:
+                    break
+            with timer.stage("step"):
+                if kind == "chunk":
+                    xs, ys = payload
+                    xs = jnp.asarray(xs)
+                    if dev_fn is not None:
+                        xs = dev_fn(xs)
+                    self.fit_scan(xs, ys)
+                else:
+                    # the fallback path must normalize too — the iterator
+                    # intentionally emitted this batch raw for a
+                    # device_side pp
+                    self._fit_batch(self._apply_dev_pp(payload, dev_fn))
+        timer.stop()
+        self.last_pipeline_stats = timer.summary()
 
     @staticmethod
     def _apply_dev_pp(ds, dev_fn):
         if dev_fn is None:
             return ds
         from deeplearning4j_tpu.data.dataset import DataSet
-        return DataSet(dev_fn(jnp.asarray(np.asarray(ds.features))),
+        return DataSet(dev_fn(jnp.asarray(ds.features)),
                        ds.labels, ds.features_mask, ds.labels_mask)
 
     def _fit_batch(self, ds):
@@ -572,24 +648,42 @@ class MultiLayerNetwork:
         batch ahead of the host read, so the device executes batch k+1
         while ``eval_fn`` consumes batch k (the serving engine's
         predict_stream does the in-flight bookkeeping). ``eval_fn`` gets
-        (labels, host_output, labels_mask) per batch."""
+        (labels, host_output, labels_mask) per batch.
+
+        Mirrors the fit path's input handling: features are staged onto
+        the device ahead of the engine (H2D overlaps the previous batch's
+        forward) and a ``device_side`` pre-processor on the iterator chain
+        runs on chip here too — a net trained with an on-chip normalizer
+        evaluates through the same transform (train/eval parity)."""
         from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.prefetcher import DevicePrefetcher
+        from deeplearning4j_tpu.util.timing import PipelineTimer
+
+        dev_fn, host_pp = self._resolve_device_pp(data)
         eng = self.serving_engine()
         metas = []
+        timer = PipelineTimer()
 
         def feats():
             for ds in data:
                 if not isinstance(ds, DataSet):
                     ds = DataSet(*ds)
+                if host_pp is not None:
+                    ds = host_pp.pre_process(ds)
                 metas.append((ds.labels, ds.labels_mask))
                 yield ds.features
 
+        staged = DevicePrefetcher(feats(), depth=max(1, self.prefetch_depth),
+                                  transform=dev_fn, timer=timer)
         # predict_stream lags ≥1 batch behind feats(), so metas[i] is
         # always populated before output i arrives
-        for i, out in enumerate(eng.predict_stream(feats())):
+        timer.start()
+        for i, out in enumerate(eng.predict_stream(staged)):
             labels, lm = metas[i]
             eval_fn(np.asarray(labels), out,
                     None if lm is None else np.asarray(lm))
+        timer.stop()
+        self.last_pipeline_stats = timer.summary()
 
     def evaluate(self, data, labels=None):
         """Classification evaluation (parity: MultiLayerNetwork.evaluate),

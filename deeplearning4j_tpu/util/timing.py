@@ -19,8 +19,83 @@ N=1 run. N is chosen adaptively so the measured delta dominates RPC jitter.
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 
 import numpy as np
+
+
+class PipelineTimer:
+    """Per-stage input-pipeline accounting (fetch / decode / h2d / step).
+
+    The containers' streamed fit path records how long the consumer loop
+    spends in each stage; ``host_stall_frac()`` is the fraction of the
+    epoch's wall time the host spent WAITING ON DATA instead of dispatching
+    device work — the number that caps accelerator utilization once the
+    compiled step is fast (un-pipelined input feeding, not FLOPs).
+
+    Stage conventions used by ``_fit_stream``:
+
+    - ``wait``  — consumer blocked in ``next()`` on the input stream. With
+      the prefetch pipeline on, this is the ONLY stall the host sees (the
+      fetch/decode/h2d work happens inside it or ahead of it).
+    - ``fetch`` / ``decode`` / ``h2d`` — informative sub-stage costs
+      recorded by the stream/prefetcher; they may be nested inside ``wait``
+      so they are NOT summed into the stall when ``wait`` was recorded.
+    - ``step`` — train-step dispatch (async on TPU: enqueue time, not
+      device time; honest device step timing is ``time_op`` below).
+
+    ``host_stall_frac`` = wait/wall when ``wait`` was recorded, else
+    (fetch+decode+h2d)/wall (the naive un-pipelined path executes those
+    stages inline on the consumer thread)."""
+
+    _STALL_FALLBACK = ("fetch", "decode", "h2d")
+
+    def __init__(self):
+        self.seconds = {}
+        self.counts = {}
+        self._t0 = None
+        self.wall = 0.0
+
+    def add(self, stage: str, sec: float):
+        self.seconds[stage] = self.seconds.get(stage, 0.0) + sec
+        self.counts[stage] = self.counts.get(stage, 0) + 1
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._t0 is not None:
+            self.wall += time.perf_counter() - self._t0
+            self._t0 = None
+        return self
+
+    def host_stall_frac(self):
+        if not self.wall:
+            return None
+        if "wait" in self.seconds:
+            stall = self.seconds["wait"]
+        else:
+            stall = sum(self.seconds.get(s, 0.0)
+                        for s in self._STALL_FALLBACK)
+        return min(1.0, stall / self.wall)
+
+    def summary(self) -> dict:
+        out = {"wall_sec": round(self.wall, 4),
+               "host_stall_frac": self.host_stall_frac()}
+        if out["host_stall_frac"] is not None:
+            out["host_stall_frac"] = round(out["host_stall_frac"], 4)
+        for k in sorted(self.seconds):
+            out[f"{k}_sec"] = round(self.seconds[k], 4)
+        return out
 
 
 def host_sync(x) -> float:

@@ -1,0 +1,431 @@
+"""Input-pipeline tests: multi-worker ETL (AsyncDataSetIterator workers=N),
+device-resident prefetch (DevicePrefetcher), per-stage stall accounting
+(PipelineTimer), and the uint8 wire + device-side normalizer path through
+fit/evaluate. Stress/soak variants are marked slow; one fast overlap smoke
+test stays in tier-1."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
+    MultipleEpochsIterator)
+from deeplearning4j_tpu.data.prefetcher import DevicePrefetcher
+from deeplearning4j_tpu.util.timing import PipelineTimer
+
+
+def _mk_ds(n=64, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, d).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > d / 2).astype(int)]
+    return DataSet(x, y)
+
+
+def _base_iter(n=64, batch=8, seed=0):
+    return ListDataSetIterator(_mk_ds(n, seed=seed), batch, shuffle=False)
+
+
+def _features(it):
+    return [np.asarray(ds.features) for ds in it]
+
+
+# --------------------------------------------------------- multi-worker ETL
+
+def test_multiworker_ordered_matches_base_exactly():
+    base_seq = _features(_base_iter())
+    for workers in (1, 2, 4):
+        a = AsyncDataSetIterator(_base_iter(), queue_size=3, workers=workers,
+                                 ordered=True)
+        got = _features(a)
+        assert len(got) == len(base_seq)
+        for g, b in zip(got, base_seq):
+            np.testing.assert_array_equal(g, b)
+
+
+def test_multiworker_unordered_same_multiset():
+    base_seq = _features(_base_iter())
+    a = AsyncDataSetIterator(_base_iter(), queue_size=3, workers=4,
+                             ordered=False)
+    got = _features(a)
+    assert len(got) == len(base_seq)
+    key = lambda arr: arr.tobytes()
+    assert sorted(key(g) for g in got) == sorted(key(b) for b in base_seq)
+
+
+def test_transform_runs_and_preserves_order():
+    """transform (the decode/augment hook) runs inside the workers; ordered
+    mode still emits exact base order."""
+    seen_threads = set()
+
+    def tx(ds):
+        seen_threads.add(threading.get_ident())
+        return DataSet(np.asarray(ds.features) * 2.0, ds.labels)
+
+    base_seq = _features(_base_iter())
+    a = AsyncDataSetIterator(_base_iter(), queue_size=3, workers=3,
+                             transform=tx)
+    got = _features(a)
+    for g, b in zip(got, base_seq):
+        np.testing.assert_array_equal(g, b * 2.0)
+    # the transform ran on worker threads, not the consumer
+    assert threading.get_ident() not in seen_threads
+
+
+def test_etl_error_delivers_prefix_then_raises():
+    """A worker error propagates to the consumer; every in-order batch
+    decoded before the failure is delivered first."""
+    def tx(ds):
+        if float(np.asarray(ds.features)[0, 0]) < 0:  # batch 3 poisoned
+            raise ValueError("decode failed")
+        return ds
+
+    ds = _mk_ds(64)
+    feats = np.asarray(ds.features).copy()
+    feats[3 * 8, 0] = -1.0
+    it = ListDataSetIterator(DataSet(feats, ds.labels), 8, shuffle=False)
+    a = AsyncDataSetIterator(it, queue_size=2, workers=2, transform=tx)
+    got = []
+    with pytest.raises(ValueError, match="decode failed"):
+        for b in a:
+            got.append(b)
+    assert len(got) == 3                     # exactly the pre-error prefix
+    for i, g in enumerate(got):
+        np.testing.assert_array_equal(np.asarray(g.features),
+                                      feats[i * 8:(i + 1) * 8])
+
+
+# ------------------------------------------------- shutdown / reset races
+
+def test_shutdown_joins_workers_blocked_on_full_queue():
+    """Regression: _shutdown vs worker q.put race. Workers blocked putting
+    into a full queue must exit promptly — one drain pass is not enough
+    because a worker can refill the freed slot before seeing the stop
+    flag."""
+    a = AsyncDataSetIterator(_base_iter(n=512, batch=4), queue_size=1,
+                             workers=4)
+    next(iter(a))                  # start workers, let the queue fill
+    time.sleep(0.2)                # all workers now blocked in q.put
+    threads = list(a._threads)
+    t0 = time.perf_counter()
+    a._shutdown()
+    assert time.perf_counter() - t0 < 5.0
+    assert all(not t.is_alive() for t in threads), "leaked worker thread"
+    assert a._threads == [] and a._q is None
+
+
+def test_double_reset_and_reuse():
+    base_seq = _features(_base_iter())
+    a = AsyncDataSetIterator(_base_iter(), queue_size=2, workers=2)
+    a.reset()
+    a.reset()                      # double reset must not wedge or leak
+    got = _features(a)
+    for g, b in zip(got, base_seq):
+        np.testing.assert_array_equal(g, b)
+    # partial consumption then re-iteration restarts cleanly
+    it = iter(a)
+    next(it)
+    got = _features(a)
+    assert len(got) == len(base_seq)
+    for g, b in zip(got, base_seq):
+        np.testing.assert_array_equal(g, b)
+    a._shutdown()
+
+
+@pytest.mark.slow
+def test_reset_soak():
+    a = AsyncDataSetIterator(_base_iter(n=128, batch=4), queue_size=2,
+                             workers=4)
+    for _ in range(40):
+        it = iter(a)
+        next(it)
+        a.reset()                  # reset with workers mid-flight
+    n_alive_before = threading.active_count()
+    a._shutdown()
+    assert threading.active_count() <= n_alive_before
+
+
+@pytest.mark.slow
+def test_backpressure_bounded_queue_soak():
+    """Slow consumer: the bounded queue must hold (workers + queue_size)
+    decoded batches at most — backpressure reaches the base."""
+    decoded = []
+
+    def tx(ds):
+        decoded.append(1)
+        return ds
+
+    a = AsyncDataSetIterator(_base_iter(n=256, batch=4), queue_size=4,
+                             workers=2, transform=tx)
+    it = iter(a)
+    next(it)
+    time.sleep(0.3)                # workers fill the queue, then block
+    # queue(4) + 2 in-flight per worker + 1 consumed + ordering stash slack
+    assert len(decoded) <= 4 + 2 * 2 + 1 + 2
+    # keep pulling from the SAME pass (a fresh iter() would restart it)
+    rest = 0
+    while True:
+        try:
+            next(a)
+        except StopIteration:
+            break
+        rest += 1
+    assert rest == 256 // 4 - 1
+    a._shutdown()
+
+
+# -------------------------------------------- compose: MultipleEpochs wrap
+
+def test_multiple_epochs_inside_async():
+    """Satellite: MultipleEpochsIterator wrapped in AsyncDataSetIterator —
+    the async workers replay the base N times through the wrapper's
+    reset-between-epochs logic, in order."""
+    base_seq = _features(_base_iter())
+    a = AsyncDataSetIterator(MultipleEpochsIterator(3, _base_iter()),
+                             queue_size=3, workers=2)
+    got = _features(a)
+    assert len(got) == 3 * len(base_seq)
+    for e in range(3):
+        for g, b in zip(got[e * len(base_seq):(e + 1) * len(base_seq)],
+                        base_seq):
+            np.testing.assert_array_equal(g, b)
+
+
+def test_multiple_epochs_forward_only_base():
+    """Forward-only base (reset is a no-op): the epoch replay yields only
+    what the stream still holds — no hang, no error."""
+    from deeplearning4j_tpu.data.streaming import StreamingDataSetIterator
+    s = StreamingDataSetIterator(batch_size=4, buffer_records=64)
+    for i in range(16):
+        s.push(np.full(3, i, np.float32),
+               np.eye(2, dtype=np.float32)[i % 2])
+    s.end()
+    a = AsyncDataSetIterator(MultipleEpochsIterator(2, s), queue_size=2,
+                             workers=2)
+    got = _features(a)
+    assert len(got) == 4               # one pass: the stream cannot rewind
+    np.testing.assert_array_equal(got[0][:, 0], [0, 1, 2, 3])
+
+
+# ------------------------------------------------------- device prefetcher
+
+def test_prefetcher_overlap_smoke():
+    """Tier-1 overlap invariant: while the consumer holds batch k (a step
+    in flight), the prefetcher already has >= 1 further batch staged on
+    device."""
+    import jax
+    pf = DevicePrefetcher(_base_iter(), depth=2)
+    it = iter(pf)
+    first = next(it)
+    assert pf.buffered >= 1            # next batch staged while we "step"
+    # staged items are device-resident jax arrays, not host numpy
+    assert isinstance(first.features, jax.Array)
+    nxt = pf._buf[0]
+    assert isinstance(nxt.features, jax.Array)
+    rest = 0
+    while True:
+        try:
+            next(it)
+        except StopIteration:
+            break
+        rest += 1
+    assert 1 + rest == 64 // 8
+    assert pf.buffered == 0
+
+
+def test_prefetcher_payloads_and_timer():
+    t = PipelineTimer()
+    src = [("chunk", (np.ones((2, 3), np.float32), np.zeros(2, np.float32))),
+           ("batch", _mk_ds(4))]
+    out = list(DevicePrefetcher(src, depth=3, timer=t))
+    assert out[0][0] == "chunk" and out[1][0] == "batch"
+    import jax
+    assert isinstance(out[0][1][0], jax.Array)
+    assert isinstance(out[1][1].features, jax.Array)
+    assert t.counts.get("h2d") == 2
+
+
+def test_pipeline_timer_stall_semantics():
+    t = PipelineTimer()
+    t.start()
+    t.add("fetch", 0.2)
+    t.add("decode", 0.1)
+    time.sleep(0.01)
+    t.stop()
+    t.wall = 1.0
+    # no wait recorded -> naive fallback: inline fetch+decode+h2d is stall
+    assert t.host_stall_frac() == pytest.approx(0.3)
+    t.add("wait", 0.05)
+    # wait recorded -> it IS the stall (sub-stages may nest inside it)
+    assert t.host_stall_frac() == pytest.approx(0.05)
+    s = t.summary()
+    assert s["host_stall_frac"] == pytest.approx(0.05)
+    assert s["fetch_sec"] == pytest.approx(0.2)
+
+
+# --------------------------------------------- fit/evaluate through the pipe
+
+def _tiny_net(seed=7):
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _params_equal(a, b):
+    import jax
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def test_fit_bitwise_identical_with_and_without_prefetch():
+    """Acceptance: the prefetched path must train BITWISE identically to
+    the naive path on the same batch stream (chunk boundaries and step
+    order do not depend on prefetch depth)."""
+    n1, n2 = _tiny_net(), _tiny_net()
+    n1.fit(_base_iter(n=96, batch=8), epochs=2, prefetch=0)
+    n2.fit(_base_iter(n=96, batch=8), epochs=2, prefetch=3)
+    assert _params_equal(n1.params, n2.params)
+    assert np.float32(n1.get_score()) == np.float32(n2.get_score())
+    assert n2.last_pipeline_stats["host_stall_frac"] is not None
+
+
+def test_fit_bitwise_identical_through_multiworker_etl():
+    n1, n2 = _tiny_net(), _tiny_net()
+    n1.fit(_base_iter(n=96, batch=8), epochs=1)
+    n2.fit(AsyncDataSetIterator(_base_iter(n=96, batch=8), queue_size=3,
+                                workers=4, ordered=True), epochs=1)
+    assert _params_equal(n1.params, n2.params)
+
+
+def test_cg_fit_prefetch_bitwise_parity():
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    def mk():
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=4, n_out=8,
+                                           activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                              activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out").build())
+        return ComputationGraph(conf).init()
+
+    g1, g2 = mk(), mk()
+    g1.fit(_base_iter(n=64, batch=8), epochs=1, prefetch=0)
+    g2.fit(_base_iter(n=64, batch=8), epochs=1, prefetch=2)
+    assert _params_equal(g1.params, g2.params)
+
+
+def test_train_eval_device_pp_parity():
+    """Satellite: a net trained with an on-chip normalizer must evaluate
+    through the SAME transform — uint8-wire eval equals pre-normalized
+    float eval exactly."""
+    from deeplearning4j_tpu.data.normalizers import ImagePreProcessingScaler
+
+    rng = np.random.RandomState(1)
+    xu = rng.randint(0, 256, size=(64, 4)).astype(np.uint8)
+    y = np.eye(2, dtype=np.float32)[(xu.sum(1) > 510).astype(int)]
+
+    def u8_iter():
+        it = ListDataSetIterator(DataSet(xu, y), 8, shuffle=False)
+        it.set_pre_processor(ImagePreProcessingScaler(device_side=True))
+        return it
+
+    net = _tiny_net()
+    net.fit(u8_iter(), epochs=2)
+    ev_u8 = net.evaluate(u8_iter())
+    ev_f = net.evaluate(
+        ListDataSetIterator(DataSet(xu.astype(np.float32) / 255.0, y), 8,
+                            shuffle=False))
+    assert ev_u8.accuracy() == ev_f.accuracy()
+
+    # and the raw batches really did cross the iterator as uint8
+    assert next(iter(u8_iter())).features.dtype == np.uint8
+
+
+def test_uint8_wire_fetcher_default():
+    from deeplearning4j_tpu.data.fetchers import MnistDataSetIterator
+    it = MnistDataSetIterator(32, train=True, num_examples=64, shuffle=False)
+    ds = next(iter(it))
+    assert ds.features.dtype == np.uint8
+    assert it.pre_processor is not None and it.pre_processor.device_side
+    it_f = MnistDataSetIterator(32, train=True, num_examples=64,
+                                shuffle=False, uint8_wire=False)
+    ds_f = next(iter(it_f))
+    assert ds_f.features.dtype.kind == "f"   # plain float, no wire encoding
+    np.testing.assert_allclose(np.asarray(ds.features) / 255.0,
+                               np.asarray(ds_f.features), atol=0.5 / 255)
+
+
+@pytest.mark.slow
+def test_streamed_bytes_pipeline_end_to_end():
+    """Soak: decode-from-bytes ETL through workers + prefetch trains
+    bitwise-identically to inline decode (the bench row's invariant)."""
+    import zlib
+    from deeplearning4j_tpu.data.streaming import (encode_record,
+                                                   decode_record)
+
+    ds = _mk_ds(128, seed=3)
+    wire = [zlib.compress(
+        encode_record(np.asarray(ds.features[i * 8:(i + 1) * 8]),
+                      np.asarray(ds.labels[i * 8:(i + 1) * 8])).encode())
+        for i in range(16)]
+
+    def decode(blob):
+        f, l = decode_record(zlib.decompress(blob).decode())
+        return DataSet(f, l)
+
+    class Blocks:
+        def __init__(self):
+            self._i = 0
+
+        def reset(self):
+            self._i = 0
+
+        def __iter__(self):
+            self.reset()
+            return self
+
+        def __next__(self):
+            if self._i >= len(wire):
+                raise StopIteration
+            b = wire[self._i]
+            self._i += 1
+            return b
+
+    class Inline(DataSetIterator):
+        def __init__(self):
+            self.base = Blocks()
+
+        def reset(self):
+            self.base.reset()
+
+        def __next__(self):
+            return self._emit(decode(next(self.base)))
+
+    n1, n2 = _tiny_net(), _tiny_net()
+    n1.fit(Inline(), epochs=3, prefetch=0)
+    a = AsyncDataSetIterator(Blocks(), queue_size=4, workers=4,
+                             transform=decode)
+    n2.fit(a, epochs=3)
+    a._shutdown()
+    assert _params_equal(n1.params, n2.params)
