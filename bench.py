@@ -100,6 +100,15 @@ def _emit(metric, value, unit, bar, extra=None):
     # ("not measured — input outside the timed span").
     line.setdefault("data_source", "synthetic")
     line.setdefault("host_stall_frac", None)
+    # every row carries the process-wide counter snapshot (train steps,
+    # compile events, serving calls...) so BENCH_*.json records what device
+    # work actually backed each number
+    try:
+        from deeplearning4j_tpu.monitor import get_registry
+        line.setdefault("registry", get_registry().snapshot(
+            kinds=("counter",)))
+    except Exception:
+        pass
     print(json.dumps(line), flush=True)
     _EMITTED.append(line)
     return line
@@ -860,6 +869,67 @@ class ListDataSetIteratorLazy:
         return DataSet(self.x[s], self.y[s])
 
 
+def bench_observability(batch=128, blocks=24, passes=3):
+    """Cost of the monitoring subsystem on a real fit loop: one LeNet-MNIST
+    streamed epoch timed with (a) monitoring off, (b) metrics on (the
+    default), (c) metrics + span tracing on — three fresh same-seed nets
+    over the SAME batch list, warmed then min-over-passes. Rows report
+    overhead %% vs the monitoring-off epoch (bar: 3%%, the acceptance
+    ceiling for metrics-on). The final scores of all three runs must match
+    BITWISE — monitoring must observe training, never perturb it."""
+    from __graft_entry__ import _lenet_conf
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.fetchers import load_mnist, data_source
+    from deeplearning4j_tpu.monitor import get_registry, trace
+    from deeplearning4j_tpu.util.timing import host_sync
+
+    x, y = load_mnist(train=True, num_examples=batch * blocks, flatten=False)
+    data = [DataSet(x[i * batch:(i + 1) * batch],
+                    y[i * batch:(i + 1) * batch]) for i in range(blocks)]
+    reg = get_registry()
+
+    def measure(metrics_on, trace_on):
+        net = MultiLayerNetwork(_lenet_conf()).init()
+        reg.enabled = metrics_on
+        trace.enable(trace_on)
+        try:
+            net.fit(data)                      # warm: compile + first epoch
+            host_sync(net._score)
+            best = float("inf")
+            for _ in range(passes):
+                t0 = time.perf_counter()
+                net.fit(data)
+                host_sync(net._score)
+                best = min(best, time.perf_counter() - t0)
+        finally:
+            reg.enabled = True
+            trace.enable(False)
+            trace.clear()
+        return best, float(net.get_score())
+
+    t_off, s_off = measure(False, False)
+    t_met, s_met = measure(True, False)
+    t_tr, s_tr = measure(True, True)
+    identical = (s_off == s_met == s_tr)
+    src = data_source("mnist")
+    out = None
+    for tag, t in (("metrics", t_met), ("metrics+tracing", t_tr)):
+        pct = max(0.0, (t - t_off) / t_off * 100.0)
+        out = _emit(
+            f"Observability overhead: LeNet fit epoch with {tag} on "
+            f"(batch={batch}, {blocks} blocks)", pct, "percent", 3.0,
+            {"epoch_sec_off": round(t_off, 4),
+             "epoch_sec_on": round(t, 4),
+             "bitwise_identical_score": identical,
+             "data_source": src})
+    if not identical:
+        raise AssertionError(
+            f"monitoring changed training: scores off={s_off} "
+            f"metrics={s_met} tracing={s_tr}")
+    return out
+
+
 # ordered CHEAP-FIRST: the first five benches measured 2-4 min total on
 # warm cache (their _EST entries carry contention headroom on top), so
 # under the default budget they record before the expensive MFU-bar
@@ -870,6 +940,7 @@ BENCHES = {
     "lenet": bench_lenet,
     "input_pipeline": bench_input_pipeline,
     "serving": bench_serving,
+    "observability": bench_observability,
     "word2vec": bench_word2vec,
     "parallelwrapper": bench_parallel_wrapper,
     "vgg16": bench_vgg16,
@@ -885,7 +956,8 @@ BENCHES = {
 # headroom for pool contention). Used only for skip-with-reason decisions.
 _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "resnet50": 150, "lenet": 90, "vgg16": 90, "input_pipeline": 120,
-        "parallelwrapper": 150, "word2vec": 120, "serving": 120}
+        "parallelwrapper": 150, "word2vec": 120, "serving": 120,
+        "observability": 100}
 
 
 def main(argv=None):

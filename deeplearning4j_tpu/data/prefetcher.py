@@ -31,6 +31,8 @@ from collections import deque
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor.tracing import trace
+
 
 def _device_put_tree(item, device=None):
     """device_put every array leaf of a DataSet / tuple / list / dict."""
@@ -114,9 +116,10 @@ class DevicePrefetcher:
                 self._exhausted = True
                 break
             t1 = _time.perf_counter()
-            staged = _device_put_tree(item, self.device)
-            if self.transform is not None:
-                staged = self.transform(staged)
+            with trace.span("h2d"):
+                staged = _device_put_tree(item, self.device)
+                if self.transform is not None:
+                    staged = self.transform(staged)
             # upstream stages (fetch/decode) time themselves; only the
             # device_put dispatch is this stage's own cost
             if self.timer is not None:

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from deeplearning4j_tpu.monitor.tracing import trace
 from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.updaters import make_gradient_transform
 from deeplearning4j_tpu.nn.layers.special import FrozenLayer
@@ -51,6 +52,8 @@ class ComputationGraph:
         self._output_fn = None
         self._serving = None          # bucketed inference engine (lazy)
         self._transforms = None
+        self._compile_count = 0       # train programs traced (see _note_compile)
+        self._train_mon = None        # lazy TrainMonitor (metric children)
 
     # ------------------------------------------------------------------ init
     def init(self, rng=None):
@@ -239,6 +242,18 @@ class ComputationGraph:
             new_params[name], new_opt[name] = np_, o
         return new_params, new_opt
 
+    def _note_compile(self):
+        # called from inside jitted train-step bodies: runs only while jit
+        # traces a NEW signature, i.e. exactly once per compiled program
+        self._compile_count += 1
+
+    @property
+    def _mon(self):
+        if self._train_mon is None:
+            from deeplearning4j_tpu.monitor.hooks import TrainMonitor
+            self._train_mon = TrainMonitor(type(self).__name__)
+        return self._train_mon
+
     # ----------------------------------------------------------- train step
     def _loss_for_grad(self):
         """jax.checkpoint-wrapped loss when remat is configured (see
@@ -250,6 +265,7 @@ class ComputationGraph:
         loss_fn = self._loss_for_grad()
 
         def step(params, state, opt_state, inputs, labels, it, masks, label_masks):
+            self._note_compile()
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.conf.global_conf.seed), it)
             (loss, new_state), grads = jax.value_and_grad(
@@ -281,6 +297,8 @@ class ComputationGraph:
             loss_fn = self._loss_for_grad()
 
             def inner(params, state, opt_state, xs, ys, it0):
+                self._note_compile()
+
                 def body(carry, inp):
                     params, state, opt_state, it = carry
                     x, y = inp
@@ -298,14 +316,22 @@ class ComputationGraph:
                 return p, s, o, losses
 
             self._scan_fit = jax.jit(inner, donate_argnums=(0, 1, 2))
+        c0, t0 = self._compile_count, time.perf_counter()
         self.params, self.state, self.opt_state, losses = self._scan_fit(
             self.params, self.state, self.opt_state, inputs_steps,
             labels_steps, jnp.asarray(self.iteration, jnp.int32))
         self._last_input = [a[-1] for a in inputs_steps]  # activation capture
-        self.iteration += int(inputs_steps[0].shape[0])
+        n_steps = int(inputs_steps[0].shape[0])
+        self.iteration += n_steps
         self._score = losses[-1]
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        self._mon.record(seconds=time.perf_counter() - t0, steps=n_steps,
+                         examples=n_steps * int(inputs_steps[0].shape[1]),
+                         score=self._score,
+                         compiled=self._compile_count - c0, path="scan")
+        if self.listeners:
+            with trace.span("callback"):
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
     def fit(self, data, labels=None, epochs=1, prefetch=None):
@@ -388,7 +414,8 @@ class ComputationGraph:
         while True:
             t0 = time.perf_counter()
             try:
-                batch = next(it)
+                with trace.span("fetch"):
+                    batch = next(it)
             except StopIteration:
                 break
             timer.add("fetch", time.perf_counter() - t0)
@@ -458,30 +485,35 @@ class ComputationGraph:
         it = iter(stream)
         timer.start()
         while True:
-            with timer.stage("wait"):
-                try:
-                    kind, payload = next(it)
-                except StopIteration:
-                    break
-            with timer.stage("step"):
-                if kind == "chunk":
-                    xs, ys = payload
-                    xs = [jnp.asarray(a) for a in xs]
-                    if dev_fn is not None:
-                        xs = [dev_fn(a) for a in xs]
-                    self.fit_scan(xs, ys)
-                else:
-                    # fallback batches must be normalized too (the
-                    # iterator emitted them raw for a device_side
-                    # processor)
-                    self._fit_batch(dev_mds(payload))
+            # one "train_step" span per consumer iteration (nests the wait
+            # and the step — see MultiLayerNetwork._fit_stream)
+            with trace.span("train_step"):
+                with timer.stage("wait"):
+                    try:
+                        kind, payload = next(it)
+                    except StopIteration:
+                        break
+                with timer.stage("step"):
+                    if kind == "chunk":
+                        xs, ys = payload
+                        xs = [jnp.asarray(a) for a in xs]
+                        if dev_fn is not None:
+                            xs = [dev_fn(a) for a in xs]
+                        self.fit_scan(xs, ys)
+                    else:
+                        # fallback batches must be normalized too (the
+                        # iterator emitted them raw for a device_side
+                        # processor)
+                        self._fit_batch(dev_mds(payload))
         timer.stop()
         self.last_pipeline_stats = timer.summary()
+        timer.publish("fit")
 
     def _fit_batch(self, mds):
         inputs = [jnp.asarray(f) for f in mds.features]
         labels = [jnp.asarray(l) for l in mds.labels]
         self._last_input = inputs     # device ref for activation capture
+        c0, t0 = self._compile_count, time.perf_counter()
         masks = None
         if mds.features_masks and any(m is not None for m in mds.features_masks):
             masks = {n: jnp.asarray(m) for n, m in
@@ -504,15 +536,22 @@ class ComputationGraph:
                 jnp.asarray(self.iteration, jnp.int32), masks, label_masks)
             self._score = loss  # device scalar; host-read deferred to
                                 # get_score() (sync ~100ms on tunneled TPUs)
+        self._last_fit_time = time.perf_counter() - t0
         self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        self._mon.record(seconds=self._last_fit_time, steps=1,
+                         examples=int(inputs[0].shape[0]), score=self._score,
+                         compiled=self._compile_count - c0, path="batch")
+        if self.listeners:
+            with trace.span("callback"):
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
     # ---------------------------------------------------------------- tbptt
     def _make_tbptt_step(self):
         def step(params, state, opt_state, inputs, labels, it, masks,
                  label_masks, carries):
+            self._note_compile()
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.conf.global_conf.seed), it)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
@@ -711,6 +750,7 @@ class ComputationGraph:
             ev.eval(np.asarray(labels[i]), out)
         timer.stop()
         self.last_pipeline_stats = timer.summary()
+        timer.publish("eval")
         return ev
 
     # ------------------------------------------------------------- utilities

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from deeplearning4j_tpu.monitor.tracing import trace
 from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.updaters import make_gradient_transform
 from deeplearning4j_tpu.nn.layers.special import FrozenLayer
@@ -57,6 +58,8 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._serving = None          # bucketed inference engine (lazy)
         self._transforms = None
+        self._compile_count = 0       # train programs traced (see _note_compile)
+        self._train_mon = None        # lazy TrainMonitor (metric children)
 
     # ------------------------------------------------------------------ init
     def init(self, rng=None):
@@ -217,6 +220,18 @@ class MultiLayerNetwork:
             new_opt.append(o)
         return new_params, new_opt
 
+    def _note_compile(self):
+        # called from inside jitted train-step bodies: runs only while jit
+        # traces a NEW signature, i.e. exactly once per compiled program
+        self._compile_count += 1
+
+    @property
+    def _mon(self):
+        if self._train_mon is None:
+            from deeplearning4j_tpu.monitor.hooks import TrainMonitor
+            self._train_mon = TrainMonitor(type(self).__name__)
+        return self._train_mon
+
     # ----------------------------------------------------------- train step
     def _loss_for_grad(self):
         """The differentiated loss: jax.checkpoint-wrapped when remat is
@@ -229,6 +244,7 @@ class MultiLayerNetwork:
         loss_fn = self._loss_for_grad()
 
         def step(params, state, opt_state, x, y, it, mask_f, mask_l, carries):
+            self._note_compile()
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(self.conf.global_conf.seed), it)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
@@ -267,6 +283,8 @@ class MultiLayerNetwork:
             loss_fn = self._loss_for_grad()
 
             def inner(params, state, opt_state, xs, ys, it0):
+                self._note_compile()
+
                 def body(carry, inp):
                     params, state, opt_state, it = carry
                     x, y = inp
@@ -284,14 +302,22 @@ class MultiLayerNetwork:
                 return p, s, o, losses
 
             self._scan_fit = jax.jit(inner, donate_argnums=(0, 1, 2))
+        c0, t0 = self._compile_count, time.perf_counter()
         self.params, self.state, self.opt_state, losses = self._scan_fit(
             self.params, self.state, self.opt_state, xs, ys,
             jnp.asarray(self.iteration, jnp.int32))
         self._last_input = xs[-1]     # device ref for activation capture
         self.iteration += int(xs.shape[0])
         self._score = losses[-1]
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        self._mon.record(seconds=time.perf_counter() - t0,
+                         steps=int(xs.shape[0]),
+                         examples=int(xs.shape[0]) * int(xs.shape[1]),
+                         score=self._score,
+                         compiled=self._compile_count - c0, path="scan")
+        if self.listeners:
+            with trace.span("callback"):
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
     def fit(self, data, labels=None, epochs=1, prefetch=None):
@@ -397,7 +423,8 @@ class MultiLayerNetwork:
         while True:
             t0 = time.perf_counter()
             try:
-                batch = next(it)
+                with trace.span("fetch"):
+                    batch = next(it)
             except StopIteration:
                 break
             timer.add("fetch", time.perf_counter() - t0)
@@ -447,25 +474,29 @@ class MultiLayerNetwork:
         it = iter(stream)
         timer.start()
         while True:
-            with timer.stage("wait"):
-                try:
-                    kind, payload = next(it)
-                except StopIteration:
-                    break
-            with timer.stage("step"):
-                if kind == "chunk":
-                    xs, ys = payload
-                    xs = jnp.asarray(xs)
-                    if dev_fn is not None:
-                        xs = dev_fn(xs)
-                    self.fit_scan(xs, ys)
-                else:
-                    # the fallback path must normalize too — the iterator
-                    # intentionally emitted this batch raw for a
-                    # device_side pp
-                    self._fit_batch(self._apply_dev_pp(payload, dev_fn))
+            # one "train_step" span per consumer iteration: it nests the
+            # wait (and any fetch/h2d work surfaced inside it) + the step
+            with trace.span("train_step"):
+                with timer.stage("wait"):
+                    try:
+                        kind, payload = next(it)
+                    except StopIteration:
+                        break
+                with timer.stage("step"):
+                    if kind == "chunk":
+                        xs, ys = payload
+                        xs = jnp.asarray(xs)
+                        if dev_fn is not None:
+                            xs = dev_fn(xs)
+                        self.fit_scan(xs, ys)
+                    else:
+                        # the fallback path must normalize too — the
+                        # iterator intentionally emitted this batch raw
+                        # for a device_side pp
+                        self._fit_batch(self._apply_dev_pp(payload, dev_fn))
         timer.stop()
         self.last_pipeline_stats = timer.summary()
+        timer.publish("fit")
 
     @staticmethod
     def _apply_dev_pp(ds, dev_fn):
@@ -482,8 +513,9 @@ class MultiLayerNetwork:
         mf = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         ml = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
         self._last_input = x          # device ref for activation-capture
-        t0 = time.perf_counter()      # listeners (ConvolutionalIteration-
-        if self.conf.backprop_type == "tbptt" and x.ndim == 3:   # Listener)
+        c0 = self._compile_count      # listeners (ConvolutionalIteration-
+        t0 = time.perf_counter()      # Listener)
+        if self.conf.backprop_type == "tbptt" and x.ndim == 3:
             self._fit_tbptt(x, y, mf, ml)
         else:
             step = self._get_train_step(mf is not None or ml is not None, False)
@@ -495,8 +527,13 @@ class MultiLayerNetwork:
                                     # tunneled TPU attachments)
         self._last_fit_time = time.perf_counter() - t0
         self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        self._mon.record(seconds=self._last_fit_time, steps=1,
+                         examples=int(x.shape[0]), score=self._score,
+                         compiled=self._compile_count - c0, path="batch")
+        if self.listeners:
+            with trace.span("callback"):
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
     # -------------------------------------------------------------- pretrain
@@ -684,6 +721,7 @@ class MultiLayerNetwork:
                     None if lm is None else np.asarray(lm))
         timer.stop()
         self.last_pipeline_stats = timer.summary()
+        timer.publish("eval")
 
     def evaluate(self, data, labels=None):
         """Classification evaluation (parity: MultiLayerNetwork.evaluate),

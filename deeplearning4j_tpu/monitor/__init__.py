@@ -1,0 +1,31 @@
+"""Unified observability: pull metrics + span tracing, zero external deps.
+
+Two stores, one subsystem:
+
+- ``metrics`` — a process-wide ``MetricsRegistry`` of Counter / Gauge /
+  fixed-bucket Histogram families (labels supported) rendered in the
+  Prometheus text exposition format. Scraped at ``GET /metrics`` on the
+  serving server; read in-process by ``/stats``, the UI StatsListener and
+  bench row snapshots — all the same numbers, so surfaces cannot drift.
+- ``tracing`` — a ring-buffered span tracer (``with trace.span("step")``)
+  exporting Chrome trace-event JSON for Perfetto; spans cover the train
+  loop (wait/fetch/h2d/step/callback) and the serving path
+  (enqueue/bucket/pad/device/readback).
+
+Both are cheap enough to leave on (see the bench's
+``observability_overhead`` row); tracing is opt-in via
+``trace.enable()`` / ``DL4JTPU_TRACE``. Metric name catalog and usage in
+docs/OBSERVABILITY.md.
+"""
+
+from deeplearning4j_tpu.monitor.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, get_registry,
+    set_metrics_enabled, DEFAULT_LATENCY_BUCKETS, DEFAULT_STEP_BUCKETS)
+from deeplearning4j_tpu.monitor.tracing import Tracer, trace, get_tracer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "set_metrics_enabled",
+    "DEFAULT_LATENCY_BUCKETS", "DEFAULT_STEP_BUCKETS",
+    "Tracer", "trace", "get_tracer",
+]

@@ -1,0 +1,154 @@
+"""Low-overhead span tracing exported as Chrome trace-event JSON.
+
+The per-step timeline half of the observability subsystem (fleet counters
+are ``monitor/metrics.py``). Spans follow the Dapper model (Sigelman et
+al., 2010) collapsed to one process: nestable named intervals recorded
+per thread, serialized as ``B``/``E`` (duration begin/end) events in the
+Chrome trace-event format — load the exported file straight into
+Perfetto (https://ui.perfetto.dev) or ``chrome://tracing`` and the
+``train_step`` spans visually nest their ``wait``/``fetch``/``h2d``/
+``step``/``callback`` children; the serving path shows
+``enqueue``/``bucket``/``pad``/``device``/``readback``.
+
+Overhead discipline: tracing is OFF by default; a disabled tracer's
+``span()`` returns one shared no-op context manager (no allocation, no
+clock read). Enabled, each span costs two ``perf_counter`` reads and two
+dict appends into a bounded ring buffer (old events are dropped, the
+process never grows without bound). The bench's ``observability_overhead``
+row pins the cost of both states.
+
+Enable via code (``trace.enable()``) or environment::
+
+    DL4JTPU_TRACE=1                 # collect; export manually
+    DL4JTPU_TRACE=/tmp/step.json    # collect + auto-export at exit
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+__all__ = ["Tracer", "trace", "get_tracer"]
+
+
+class _NullSpan:
+    """Shared no-op context manager returned while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tr", "_name", "_args")
+
+    def __init__(self, tr, name, args):
+        self._tr = tr
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        tr = self._tr
+        ev = {"ph": "B", "name": self._name, "pid": tr._pid,
+              "tid": threading.get_ident(),
+              "ts": (time.perf_counter() - tr._t0) * 1e6}
+        if self._args:
+            ev["args"] = self._args
+        tr._events.append(ev)
+        return self
+
+    def __exit__(self, *exc):
+        tr = self._tr
+        tr._events.append(
+            {"ph": "E", "name": self._name, "pid": tr._pid,
+             "tid": threading.get_ident(),
+             "ts": (time.perf_counter() - tr._t0) * 1e6})
+        return False
+
+
+class Tracer:
+    """Ring-buffered span recorder.
+
+    ``capacity`` bounds memory: a deque(maxlen) of event dicts — at the
+    default 200k events (~100k spans) a steady-state training loop keeps
+    the most recent few thousand steps, which is what a stall
+    investigation actually looks at."""
+
+    def __init__(self, capacity: int = 200_000, enabled: bool = False):
+        self._events = deque(maxlen=int(capacity))
+        self._enabled = bool(enabled)
+        self._pid = os.getpid()
+        self._t0 = time.perf_counter()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, on: bool = True) -> "Tracer":
+        self._enabled = bool(on)
+        return self
+
+    def clear(self) -> "Tracer":
+        self._events.clear()
+        return self
+
+    def span(self, name: str, **args):
+        """``with trace.span("step"): ...`` — nest freely; disabled
+        tracing returns a shared no-op (near-zero cost)."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args):
+        """Point-in-time marker (Chrome ``i`` event)."""
+        if not self._enabled:
+            return
+        ev = {"ph": "i", "name": name, "pid": self._pid,
+              "tid": threading.get_ident(), "s": "t",
+              "ts": (time.perf_counter() - self._t0) * 1e6}
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    def events(self) -> list:
+        return list(self._events)
+
+    def export(self, path: Optional[str] = None) -> dict:
+        """The Chrome trace-event document; written to ``path`` as JSON
+        when given. Events are sorted by timestamp so a ring-buffer wrap
+        (which may drop a ``B`` while keeping its ``E``) still loads."""
+        doc = {"traceEvents": sorted(self._events, key=lambda e: e["ts"]),
+               "displayTimeUnit": "ms"}
+        if path:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
+
+
+# ---------------------------------------------------------------- default
+# The process-wide tracer every instrumented path records into (the span
+# analog of metrics.get_registry()).
+trace = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return trace
+
+
+_env = os.environ.get("DL4JTPU_TRACE", "")
+if _env and _env.lower() not in ("0", "false", "off", "no"):
+    trace.enable(True)
+    if os.sep in _env or _env.endswith(".json"):
+        atexit.register(trace.export, _env)

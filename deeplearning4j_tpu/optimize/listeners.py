@@ -26,7 +26,9 @@ class IterationListener:
 
 
 class ScoreIterationListener(IterationListener):
-    """Log score every N iterations (parity: ScoreIterationListener)."""
+    """Log score every N iterations (parity: ScoreIterationListener).
+    Emits through the ``deeplearning4j_tpu`` logger ONLY — attach a handler
+    (or logging.basicConfig) to see it on a console."""
 
     def __init__(self, print_iterations: int = 10):
         self.print_iterations = max(1, print_iterations)
@@ -34,18 +36,42 @@ class ScoreIterationListener(IterationListener):
     def iteration_done(self, model, iteration, epoch):
         if iteration % self.print_iterations == 0:
             log.info("Score at iteration %d is %s", iteration, model.get_score())
-            print(f"Score at iteration {iteration} is {model.get_score()}")
 
 
 class PerformanceListener(IterationListener):
     """Throughput reporting (parity: PerformanceListener — samples/sec,
-    batches/sec; ETL time here is host wait before device dispatch)."""
+    batches/sec; ETL time here is host wait before device dispatch).
 
-    def __init__(self, frequency: int = 10, report_batch: bool = True):
+    ``registry``: optional MetricsRegistry (default: the process-wide one)
+    that receives ``dl4jtpu_listener_batches_per_sec`` /
+    ``dl4jtpu_listener_samples_per_sec`` gauges at each report, so wall-clock
+    training throughput is scrapeable alongside the step counters."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True,
+                 registry=None):
         self.frequency = max(1, frequency)
         self.report_batch = report_batch
         self._last_time = None
         self._last_iter = None
+        if registry is None:
+            from deeplearning4j_tpu.monitor.metrics import get_registry
+            registry = get_registry()
+        self._g_batches = registry.gauge(
+            "dl4jtpu_listener_batches_per_sec",
+            "Wall-clock batches/sec over the listener's last report window.")
+        self._g_samples = registry.gauge(
+            "dl4jtpu_listener_samples_per_sec",
+            "Wall-clock examples/sec over the listener's last report window.")
+
+    @staticmethod
+    def _batch_rows(model):
+        x = getattr(model, "_last_input", None)
+        if isinstance(x, (list, tuple)):       # ComputationGraph inputs
+            x = x[0] if x else None
+        try:
+            return int(x.shape[0])
+        except Exception:
+            return None
 
     def iteration_done(self, model, iteration, epoch):
         now = time.perf_counter()
@@ -54,13 +80,17 @@ class PerformanceListener(IterationListener):
             iters = iteration - self._last_iter
             if dt > 0 and iters > 0:
                 batch_sec = iters / dt
-                msg = (f"iteration {iteration}: {batch_sec:.1f} batches/sec, "
-                       f"score {model.get_score():.5f}")
+                self._g_batches.set(batch_sec)
+                rows = self._batch_rows(model)
+                msg = f"iteration {iteration}: {batch_sec:.1f} batches/sec"
+                if rows:
+                    self._g_samples.set(batch_sec * rows)
+                    msg += f", {batch_sec * rows:.0f} samples/sec"
+                msg += f", score {model.get_score():.5f}"
                 fit_t = getattr(model, "_last_fit_time", None)
                 if fit_t:
                     msg += f", last step {fit_t * 1e3:.1f} ms"
                 log.info(msg)
-                print(msg)
             self._last_time = now
             self._last_iter = iteration
         elif self._last_time is None:
@@ -93,9 +123,8 @@ class EvaluativeListener(IterationListener):
     def _run(self, model, tag):
         ev = model.evaluate(self.test_data)
         self.evaluations.append((tag, ev))
-        msg = f"Evaluation at {tag}: accuracy {ev.accuracy():.4f} f1 {ev.f1():.4f}"
-        log.info(msg)
-        print(msg)
+        log.info("Evaluation at %s: accuracy %.4f f1 %.4f",
+                 tag, ev.accuracy(), ev.f1())
 
     def iteration_done(self, model, iteration, epoch):
         if self.invocation == "iteration" and iteration % self.frequency == 0:
@@ -153,7 +182,5 @@ class TimeIterationListener(IterationListener):
             elapsed = time.perf_counter() - self._start
             rate = iteration / elapsed
             remaining = (self.total - iteration) / rate if rate > 0 else 0
-            msg = (f"iteration {iteration}/{self.total}, elapsed "
-                   f"{elapsed:.0f}s, ETA {remaining:.0f}s")
-            log.info(msg)
-            print(msg)
+            log.info("iteration %d/%d, elapsed %.0fs, ETA %.0fs",
+                     iteration, self.total, elapsed, remaining)

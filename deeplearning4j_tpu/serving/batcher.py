@@ -15,6 +15,7 @@ a traffic spike from growing the heap without bound.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -22,6 +23,9 @@ from concurrent.futures import Future
 from typing import Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.monitor import (
+    DEFAULT_LATENCY_BUCKETS, get_registry, trace)
 
 
 class MicroBatcher:
@@ -34,6 +38,8 @@ class MicroBatcher:
     batch arrives; the classic throughput/latency trade.
     """
 
+    _ids = itertools.count()
+
     def __init__(self, engine, max_batch: int = 256,
                  max_latency_ms: float = 2.0, max_queue: int = 1024,
                  submit_timeout: float = 30.0):
@@ -44,11 +50,31 @@ class MicroBatcher:
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        # serving counters (exposed at /stats)
-        self.n_requests = 0
-        self.n_rows = 0
-        self.n_device_calls = 0
-        self._lock = threading.Lock()
+        # serving counters live in the process-wide registry: /stats, the
+        # bench snapshots and GET /metrics all read the same cells
+        self.id = f"batcher{next(MicroBatcher._ids)}"
+        reg = get_registry()
+        lab = {"batcher": self.id}
+        self._m_requests = reg.counter(
+            "dl4jtpu_serving_requests_total",
+            "Requests answered by the micro-batcher.",
+            ("batcher",)).labels(**lab)
+        self._m_rows = reg.counter(
+            "dl4jtpu_serving_rows_total",
+            "Rows answered by the micro-batcher.", ("batcher",)).labels(**lab)
+        self._m_device_calls = reg.counter(
+            "dl4jtpu_serving_device_calls_total",
+            "Merged device calls issued (avg merge = requests / calls).",
+            ("batcher",)).labels(**lab)
+        self._m_latency = reg.histogram(
+            "dl4jtpu_serving_request_latency_seconds",
+            "End-to-end request latency: submit() to future resolution "
+            "(queueing + merge wait + device call + readback).",
+            ("batcher",), buckets=DEFAULT_LATENCY_BUCKETS).labels(**lab)
+        reg.gauge(
+            "dl4jtpu_serving_queue_depth",
+            "Requests waiting in the micro-batch queue right now.",
+            ("batcher",)).labels(**lab).set_function(self._q.qsize)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "MicroBatcher":
@@ -67,10 +93,10 @@ class MicroBatcher:
         # fail anything still queued so callers don't hang on dead futures
         while True:
             try:
-                _, fut = self._q.get_nowait()
+                item = self._q.get_nowait()
             except queue.Empty:
                 break
-            fut.set_exception(RuntimeError("micro-batcher stopped"))
+            item[1].set_exception(RuntimeError("micro-batcher stopped"))
 
     # -------------------------------------------------------------- serving
     def submit(self, x) -> Future:
@@ -81,7 +107,9 @@ class MicroBatcher:
             self.start()
         x = np.asarray(x)
         fut: Future = Future()
-        self._q.put((x, fut), timeout=self.submit_timeout)
+        with trace.span("enqueue", rows=int(x.shape[0])):
+            self._q.put((x, fut, time.perf_counter()),
+                        timeout=self.submit_timeout)
         return fut
 
     def predict(self, x):
@@ -115,25 +143,44 @@ class MicroBatcher:
                 if isinstance(out, list):   # multi-output graph: first head
                     out = out[0]
                 ofs = 0
-                for x, fut in batch:
+                done = time.perf_counter()
+                for x, fut, t0 in batch:
                     fut.set_result(out[ofs:ofs + x.shape[0]])
+                    self._m_latency.observe(done - t0)
                     ofs += x.shape[0]
-                with self._lock:
-                    self.n_requests += len(batch)
-                    self.n_rows += total
-                    self.n_device_calls += 1
+                self._m_requests.inc(len(batch))
+                self._m_rows.inc(total)
+                self._m_device_calls.inc()
             except Exception as e:  # noqa: BLE001 — answer every caller
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+                for item in batch:
+                    if not item[1].done():
+                        item[1].set_exception(e)
 
     # ---------------------------------------------------------------- stats
+    # the legacy counter attributes are read-only views over the registry
+    # cells, so /stats and /metrics can never disagree
+    @property
+    def n_requests(self) -> int:
+        return int(self._m_requests.value)
+
+    @property
+    def n_rows(self) -> int:
+        return int(self._m_rows.value)
+
+    @property
+    def n_device_calls(self) -> int:
+        return int(self._m_device_calls.value)
+
     def stats(self) -> dict:
-        with self._lock:
-            calls = self.n_device_calls
-            return {"requests": self.n_requests, "rows": self.n_rows,
-                    "device_calls": calls,
-                    "avg_merge": (self.n_requests / calls) if calls else 0.0,
-                    "queue_depth": self._q.qsize(),
-                    "max_batch": self.max_batch,
-                    "max_latency_ms": self.max_latency_ms}
+        calls = self.n_device_calls
+        p50 = self._m_latency.percentile(0.5)
+        p99 = self._m_latency.percentile(0.99)
+        return {"id": self.id,
+                "requests": self.n_requests, "rows": self.n_rows,
+                "device_calls": calls,
+                "avg_merge": (self.n_requests / calls) if calls else 0.0,
+                "queue_depth": self._q.qsize(),
+                "latency_p50_ms": None if p50 is None else p50 * 1e3,
+                "latency_p99_ms": None if p99 is None else p99 * 1e3,
+                "max_batch": self.max_batch,
+                "max_latency_ms": self.max_latency_ms}

@@ -26,6 +26,7 @@ compiled-program count with no XLA internals involved.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -34,6 +35,8 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from deeplearning4j_tpu.monitor import get_registry, trace
 
 
 def bucket_for(n: int, max_batch: int, min_bucket: int = 1) -> int:
@@ -67,16 +70,39 @@ class InferenceEngine:
     cached, never the weights.
     """
 
+    _ids = itertools.count()
+
     def __init__(self, model, max_batch: int = 1024, min_bucket: int = 1):
         self.model = model
         self.max_batch = int(max_batch)
         self.min_bucket = int(min_bucket)
-        self.trace_count = 0
         self._traced_keys = set()
         self._fwd = None
         self._lock = threading.Lock()
         self._is_graph = hasattr(model.conf, "network_inputs")
         self.warmup_seconds: Optional[float] = None
+        # registry-backed counters: /stats and /metrics read the SAME cells
+        self.id = f"engine{next(InferenceEngine._ids)}"
+        reg = get_registry()
+        lab = {"engine": self.id}
+        self._m_compiled = reg.counter(
+            "dl4jtpu_serving_compiled_programs_total",
+            "XLA programs traced by the inference engine (one per bucket "
+            "shape signature).", ("engine",)).labels(**lab)
+        self._m_rows = reg.counter(
+            "dl4jtpu_serving_batch_rows_total",
+            "Real (un-padded) rows executed through bucketed device calls.",
+            ("engine",)).labels(**lab)
+        self._m_pad_rows = reg.counter(
+            "dl4jtpu_serving_pad_rows_total",
+            "Padding rows added to round batches up to bucket sizes "
+            "(pad-waste = pad / (pad + rows)).", ("engine",)).labels(**lab)
+
+    @property
+    def trace_count(self) -> int:
+        """Compiled-program count (reads the registry counter — the single
+        source of truth shared with ``/metrics``)."""
+        return int(self._m_compiled.value)
 
     # ------------------------------------------------------------- forward
     def _forward_fn(self):
@@ -105,7 +131,7 @@ class InferenceEngine:
         # signature — i.e. exactly once per compiled program
         key = (tuple((tuple(x.shape), str(x.dtype)) for x in inputs),
                None if mask is None else (tuple(mask.shape), str(mask.dtype)))
-        self.trace_count += 1
+        self._m_compiled.inc()
         self._traced_keys.add(key)
 
     # ------------------------------------------------------------- padding
@@ -131,11 +157,16 @@ class InferenceEngine:
                 for i in range(0, n, self.max_batch)]
             return [jnp.concatenate([p[j] for p in pieces])
                     for j in range(len(pieces[0]))]
-        b = bucket_for(n, self.max_batch, self.min_bucket)
-        padded = [self._pad_rows(x, b) for x in inputs]
-        mask_p = None if mask is None else self._pad_rows(mask, b)
-        outs = self._forward_fn()(self.model.params, self.model.state,
-                                  padded, mask_p)
+        with trace.span("bucket", n=n):
+            b = bucket_for(n, self.max_batch, self.min_bucket)
+        with trace.span("pad", bucket=b):
+            padded = [self._pad_rows(x, b) for x in inputs]
+            mask_p = None if mask is None else self._pad_rows(mask, b)
+        with trace.span("device", bucket=b):
+            outs = self._forward_fn()(self.model.params, self.model.state,
+                                      padded, mask_p)
+        self._m_rows.inc(n)
+        self._m_pad_rows.inc(b - n)
         return [o[:n] for o in outs]
 
     # ----------------------------------------------------------- public API
@@ -156,9 +187,10 @@ class InferenceEngine:
     def predict_host(self, x, mask=None):
         """``predict`` + host read; returns np.ndarray (or list of them)."""
         out = self.predict(x, mask)
-        if isinstance(out, list):
-            return [np.asarray(o) for o in out]
-        return np.asarray(out)
+        with trace.span("readback"):
+            if isinstance(out, list):
+                return [np.asarray(o) for o in out]
+            return np.asarray(out)
 
     def predict_stream(self, batches, depth: int = 2):
         """Pipelined inference over an iterable of batches: keeps up to
@@ -212,9 +244,15 @@ class InferenceEngine:
     # --------------------------------------------------------------- stats
     def stats(self) -> dict:
         from deeplearning4j_tpu.util.compile_cache import cache_stats
-        return {"max_batch": self.max_batch,
+        rows = self._m_rows.value
+        pad = self._m_pad_rows.value
+        return {"id": self.id,
+                "max_batch": self.max_batch,
                 "bucket_ladder": bucket_ladder(self.max_batch,
                                                self.min_bucket),
                 "compiled_programs": self.trace_count,
+                "rows": int(rows),
+                "pad_rows": int(pad),
+                "pad_waste_frac": (pad / (pad + rows)) if rows else 0.0,
                 "warmup_seconds": self.warmup_seconds,
                 "compile_cache": cache_stats()}

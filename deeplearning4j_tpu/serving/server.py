@@ -9,20 +9,36 @@ Endpoints:
   POST /predict  {"ndarray": {shape, data}}          → {"ndarray": ...}
   POST /warmup   {"input_shape": [...], "max_batch"} → {"buckets": [...]}
   GET  /stats                                        → engine+batcher stats
+  GET  /metrics                                      → Prometheus text
+  GET  /healthz                                      → {"status": "ok"}
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import urlparse
 
 from deeplearning4j_tpu.clustering.knn_server import (
     ndarray_from_b64, ndarray_to_b64)
+from deeplearning4j_tpu.monitor import get_registry
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+_KNOWN_PATHS = ("/predict", "/warmup", "/stats", "/metrics", "/healthz")
+
+
+def _http_metrics():
+    reg = get_registry()
+    return (reg.counter("dl4jtpu_http_requests_total",
+                        "HTTP requests served by the inference server.",
+                        ("path",)),
+            reg.histogram("dl4jtpu_http_request_seconds",
+                          "Wall seconds per HTTP request, handler-inclusive.",
+                          ("path",)))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -37,12 +53,42 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _text(self, body: str, content_type: str, code=200):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _observed(self, path, fn):
+        # per-path request count + latency; unknown paths share one series
+        # so a URL-probing client can't mint unbounded label values
+        counter, hist = _http_metrics()
+        label = path if path in _KNOWN_PATHS else "other"
+        t0 = time.perf_counter()
+        try:
+            fn()
+        finally:
+            counter.labels(path=label).inc()
+            hist.labels(path=label).observe(time.perf_counter() - t0)
+
     def do_GET(self):
         srv = self.server.inference
-        if urlparse(self.path).path == "/stats":
-            self._json(srv.stats())
-        else:
-            self._json({"error": "not found"}, 404)
+        path = urlparse(self.path).path
+
+        def handle():
+            if path == "/stats":
+                self._json(srv.stats())
+            elif path == "/healthz":
+                self._json({"status": "ok"})
+            elif path == "/metrics":
+                self._text(get_registry().render(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                self._json({"error": "not found"}, 404)
+
+        self._observed(path, handle)
 
     def do_POST(self):
         srv = self.server.inference
@@ -53,28 +99,32 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             self._json({"error": f"bad json: {e}"}, 400)
             return
-        try:
-            if path == "/predict":
-                x = ndarray_from_b64(payload["ndarray"])
-                if x.ndim == 1:
-                    x = x[None, :]
-                    out = srv.batcher.predict(x)[0]
+
+        def handle():
+            try:
+                if path == "/predict":
+                    x = ndarray_from_b64(payload["ndarray"])
+                    if x.ndim == 1:
+                        x = x[None, :]
+                        out = srv.batcher.predict(x)[0]
+                    else:
+                        out = srv.batcher.predict(x)
+                    self._json({"ndarray": ndarray_to_b64(out)})
+                elif path == "/warmup":
+                    shape = payload["input_shape"]
+                    shapes = ([tuple(s) for s in shape]
+                              if shape and isinstance(shape[0], list)
+                              else tuple(shape))
+                    buckets = srv.engine.warmup(
+                        shapes, max_batch=payload.get("max_batch"))
+                    self._json({"buckets": buckets,
+                                "seconds": srv.engine.warmup_seconds})
                 else:
-                    out = srv.batcher.predict(x)
-                self._json({"ndarray": ndarray_to_b64(out)})
-            elif path == "/warmup":
-                shape = payload["input_shape"]
-                shapes = ([tuple(s) for s in shape]
-                          if shape and isinstance(shape[0], list)
-                          else tuple(shape))
-                buckets = srv.engine.warmup(
-                    shapes, max_batch=payload.get("max_batch"))
-                self._json({"buckets": buckets,
-                            "seconds": srv.engine.warmup_seconds})
-            else:
-                self._json({"error": "not found"}, 404)
-        except Exception as e:  # noqa: BLE001 — service must answer
-            self._json({"error": str(e)}, 500)
+                    self._json({"error": "not found"}, 404)
+            except Exception as e:  # noqa: BLE001 — service must answer
+                self._json({"error": str(e)}, 500)
+
+        self._observed(path, handle)
 
 
 class InferenceServer:

@@ -17,6 +17,7 @@ from typing import Optional
 import numpy as np
 import jax
 
+from deeplearning4j_tpu.monitor.metrics import get_registry
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.ui.storage import StatsReport
 
@@ -66,6 +67,7 @@ class StatsListener(IterationListener):
         self._last_time = None
         self._last_params = None
         self._static_sent = False
+        self._last_step = None        # (sum, count) of the step histogram
 
     def _send_static(self, model):
         if hasattr(model, "layers"):                  # MultiLayerNetwork
@@ -87,15 +89,32 @@ class StatsListener(IterationListener):
         self.storage.put_static_info(self.session_id, info)
         self._static_sent = True
 
+    def _step_time_ms(self):
+        """Mean dispatch ms/step since the last report, from the SAME
+        registry histogram /metrics scrapes (dl4jtpu_train_step_seconds) —
+        the UI and the Prometheus surface cannot disagree. None when the
+        family is absent or no step landed in the window."""
+        fam = get_registry().get("dl4jtpu_train_step_seconds")
+        if fam is None:
+            return None
+        s = c = 0.0
+        for _, child in fam.children():
+            s += child.sum
+            c += child.count
+        prev = self._last_step or (0.0, 0.0)
+        self._last_step = (s, c)
+        ds, dc = s - prev[0], c - prev[1]
+        return (ds / dc) * 1e3 if dc > 0 else None
+
     def iteration_done(self, model, iteration, epoch):
         if not self._static_sent:
             self._send_static(model)
         if iteration % self.frequency != 0:
             return
         now = time.time()
-        dt_ms = 0.0
-        if self._last_time is not None:
-            dt_ms = (now - self._last_time) * 1e3
+        dt_ms = self._step_time_ms() or 0.0
+        if not dt_ms and self._last_time is not None:
+            dt_ms = (now - self._last_time) * 1e3   # wall-clock fallback
         self._last_time = now
 
         r = StatsReport(session_id=self.session_id, timestamp=now,

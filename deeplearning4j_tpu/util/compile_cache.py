@@ -27,6 +27,19 @@ def setup_compile_cache(cache_dir=None) -> str:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass
+    try:
+        # scrapeable cache size: live callback gauges, evaluated only when
+        # /metrics is actually pulled (a directory walk per scrape)
+        from deeplearning4j_tpu.monitor.metrics import get_registry
+        reg = get_registry()
+        reg.gauge("dl4jtpu_compile_cache_entries",
+                  "Files in the persistent XLA compilation cache."
+                  ).set_function(lambda: cache_stats(d)["entries"])
+        reg.gauge("dl4jtpu_compile_cache_bytes",
+                  "Total bytes of the persistent XLA compilation cache."
+                  ).set_function(lambda: cache_stats(d)["bytes"])
+    except Exception:
+        pass
     return str(d)
 
 

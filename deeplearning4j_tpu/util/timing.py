@@ -23,6 +23,8 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor.tracing import trace
+
 
 class PipelineTimer:
     """Per-stage input-pipeline accounting (fetch / decode / h2d / step).
@@ -62,11 +64,14 @@ class PipelineTimer:
 
     @contextmanager
     def stage(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.add(name, time.perf_counter() - t0)
+        # every timed stage is also a trace span (no-op while tracing is
+        # off), so the Perfetto timeline and the stage totals agree
+        with trace.span(name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self.add(name, time.perf_counter() - t0)
 
     def start(self):
         self._t0 = time.perf_counter()
@@ -96,6 +101,33 @@ class PipelineTimer:
         for k in sorted(self.seconds):
             out[f"{k}_sec"] = round(self.seconds[k], 4)
         return out
+
+    def publish(self, path: str):
+        """Flow this timer's stage totals into the process-wide
+        MetricsRegistry so ``host_stall_frac`` and per-stage seconds are
+        scrapeable at ``/metrics``. ``path`` labels the pipeline ("fit" /
+        "eval"). Stage counters accumulate across epochs; the stall
+        fraction gauge holds the LAST epoch's value."""
+        from deeplearning4j_tpu.monitor.metrics import get_registry
+        reg = get_registry()
+        fam = reg.counter(
+            "dl4jtpu_pipeline_stage_seconds_total",
+            "Cumulative input-pipeline stage seconds (see PipelineTimer "
+            "stage conventions).", ("path", "stage"))
+        for stage, sec in self.seconds.items():
+            fam.labels(path=path, stage=stage).inc(sec)
+        reg.counter(
+            "dl4jtpu_pipeline_wall_seconds_total",
+            "Cumulative wall seconds of streamed fit/eval epochs.",
+            ("path",)).labels(path=path).inc(self.wall)
+        frac = self.host_stall_frac()
+        if frac is not None:
+            reg.gauge(
+                "dl4jtpu_pipeline_host_stall_frac",
+                "Fraction of the last epoch's wall time the host spent "
+                "blocked waiting on data.",
+                ("path",)).labels(path=path).set(frac)
+        return self
 
 
 def host_sync(x) -> float:
