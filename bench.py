@@ -930,6 +930,77 @@ def bench_observability(batch=128, blocks=24, passes=3):
     return out
 
 
+def bench_robustness(batch=128, blocks=24, passes=3):
+    """Cost of crash-safety on a real fit loop: one LeNet-MNIST streamed
+    epoch timed with (a) no checkpointing and (b) a CheckpointListener
+    saving roughly once per epoch (atomic temp+fsync+rename write of the
+    full params/updater/meta zip) — two fresh same-seed nets over the SAME
+    batch list, warmed then min-over-passes. The row reports overhead %%
+    vs the unprotected epoch (bar: 3%%, the acceptance ceiling); extras
+    record one explicit save and restore wall time. The final scores of
+    both runs must match BITWISE — checkpointing must observe training,
+    never perturb it."""
+    import tempfile
+
+    from __graft_entry__ import _lenet_conf
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.fetchers import load_mnist, data_source
+    from deeplearning4j_tpu.resilience import CheckpointListener
+    from deeplearning4j_tpu.util.model_serializer import (restore_into,
+                                                          write_model)
+    from deeplearning4j_tpu.util.timing import host_sync
+
+    x, y = load_mnist(train=True, num_examples=batch * blocks, flatten=False)
+    data = [DataSet(x[i * batch:(i + 1) * batch],
+                    y[i * batch:(i + 1) * batch]) for i in range(blocks)]
+
+    def measure(ckpt_dir):
+        net = MultiLayerNetwork(_lenet_conf()).init()
+        kw = {}
+        if ckpt_dir is not None:
+            kw["checkpoint"] = CheckpointListener(
+                ckpt_dir, every_n_iterations=blocks, keep_last=2)
+        net.fit(data, **kw)                    # warm: compile + first epoch
+        host_sync(net._score)
+        best = float("inf")
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            net.fit(data, **kw)
+            host_sync(net._score)
+            best = min(best, time.perf_counter() - t0)
+        return best, float(net.get_score()), net
+
+    with tempfile.TemporaryDirectory() as td:
+        t_off, s_off, _ = measure(None)
+        t_on, s_on, net_on = measure(os.path.join(td, "ckpts"))
+        path = os.path.join(td, "bench_model.zip")
+        t0 = time.perf_counter()
+        write_model(net_on, path)
+        save_s = time.perf_counter() - t0
+        fresh = MultiLayerNetwork(_lenet_conf()).init()
+        t0 = time.perf_counter()
+        restore_into(fresh, path)
+        load_s = time.perf_counter() - t0
+    identical = (s_off == s_on)
+    pct = max(0.0, (t_on - t_off) / t_off * 100.0)
+    out = _emit(
+        f"Robustness overhead: LeNet fit epoch with per-epoch atomic "
+        f"checkpointing (batch={batch}, {blocks} blocks)", pct, "percent",
+        3.0,
+        {"epoch_sec_off": round(t_off, 4),
+         "epoch_sec_on": round(t_on, 4),
+         "checkpoint_save_sec": round(save_s, 4),
+         "checkpoint_restore_sec": round(load_s, 4),
+         "bitwise_identical_score": identical,
+         "data_source": data_source("mnist")})
+    if not identical:
+        raise AssertionError(
+            f"checkpointing changed training: scores off={s_off} "
+            f"on={s_on}")
+    return out
+
+
 # ordered CHEAP-FIRST: the first five benches measured 2-4 min total on
 # warm cache (their _EST entries carry contention headroom on top), so
 # under the default budget they record before the expensive MFU-bar
@@ -941,6 +1012,7 @@ BENCHES = {
     "input_pipeline": bench_input_pipeline,
     "serving": bench_serving,
     "observability": bench_observability,
+    "robustness": bench_robustness,
     "word2vec": bench_word2vec,
     "parallelwrapper": bench_parallel_wrapper,
     "vgg16": bench_vgg16,
@@ -957,7 +1029,7 @@ BENCHES = {
 _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "resnet50": 150, "lenet": 90, "vgg16": 90, "input_pipeline": 120,
         "parallelwrapper": 150, "word2vec": 120, "serving": 120,
-        "observability": 100}
+        "observability": 100, "robustness": 100}
 
 
 def main(argv=None):

@@ -146,13 +146,18 @@ class NearestNeighborsServer:
 
 
 class NearestNeighborsClient:
-    """Parity: NearestNeighborsClient.java."""
+    """Parity: NearestNeighborsClient.java. Connection failures and 5xx
+    responses retry with backoff through the shared primitive
+    (resilience/retry.py, component="knn_client")."""
 
-    def __init__(self, url: str, timeout: float = 10.0):
+    def __init__(self, url: str, timeout: float = 10.0, retries: int = 3):
+        from deeplearning4j_tpu.resilience.retry import RetryPolicy
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.retry_policy = RetryPolicy(max_attempts=max(1, retries),
+                                        base_delay=0.05, max_delay=1.0)
 
-    def _post(self, path, payload):
+    def _post_once(self, path, payload):
         req = urllib.request.Request(
             self.url + path, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"})
@@ -167,6 +172,11 @@ class NearestNeighborsClient:
         if "error" in out:
             raise RuntimeError(out["error"])
         return out
+
+    def _post(self, path, payload):
+        from deeplearning4j_tpu.resilience.retry import retry_call
+        return retry_call(self._post_once, path, payload,
+                          policy=self.retry_policy, component="knn_client")
 
     def knn(self, index: int, k: int):
         return self._post("/knn", {"index": index, "k": k})["results"]

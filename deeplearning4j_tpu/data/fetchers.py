@@ -19,11 +19,14 @@ files in makes these the true datasets.
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 from pathlib import Path
 
 import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import ListDataSetIterator
@@ -326,15 +329,32 @@ def load_image_tree(root, image_shape, num_examples=None, num_classes=None,
         order = order[:num_examples]
     xs = np.empty((len(order), h, w, c), np.float32)
     ys = np.empty(len(order), np.int64)
-    for k, oi in enumerate(order):
-        img = Image.open(paths[oi])
-        img = img.convert("RGB" if c == 3 else "L")
-        if img.size != (w, h):
-            img = img.resize((w, h))
-        arr = np.asarray(img, np.float32) / 255.0
+    k = skipped = 0
+    for oi in order:
+        try:
+            img = Image.open(paths[oi])
+            img = img.convert("RGB" if c == 3 else "L")
+            if img.size != (w, h):
+                img = img.resize((w, h))
+            arr = np.asarray(img, np.float32) / 255.0
+        except Exception:  # noqa: BLE001 — truncated/corrupt file on disk
+            # one bad file must not kill a million-image load: skip + count
+            skipped += 1
+            continue
         xs[k] = arr[..., None] if c == 1 else arr
         ys[k] = labels[oi]
-    return xs, ys, num_classes
+        k += 1
+    if skipped:
+        from deeplearning4j_tpu.monitor import get_registry
+        get_registry().counter(
+            "dl4jtpu_fetcher_unreadable_images_total",
+            "Corrupt/unreadable image files skipped by load_image_tree."
+        ).inc(skipped)
+        log.warning("load_image_tree(%s): skipped %d unreadable image(s)",
+                    root, skipped)
+    if k == 0:
+        return None
+    return xs[:k], ys[:k], num_classes
 
 
 class TinyImageNetDataSetIterator(ListDataSetIterator):

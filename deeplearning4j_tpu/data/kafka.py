@@ -14,6 +14,7 @@ base64 NDArray codec the rest of the framework speaks
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from collections import defaultdict
@@ -21,6 +22,23 @@ from typing import Dict, List, Optional
 
 from deeplearning4j_tpu.data.streaming import (
     StreamingDataSetIterator, decode_record, encode_record)
+from deeplearning4j_tpu.resilience.errors import RetriesExhaustedError
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# broker polls back off under the shared primitive; unbounded attempts —
+# a consumer pump outlives broker rebalances, give_up (the stop flag) is
+# what ends it
+_POLL_POLICY = RetryPolicy(max_attempts=None, base_delay=0.05, max_delay=2.0)
+_SEND_POLICY = RetryPolicy(max_attempts=5, base_delay=0.05, max_delay=1.0)
+
+
+def _corrupt_counter():
+    from deeplearning4j_tpu.monitor import get_registry
+    return get_registry().counter(
+        "dl4jtpu_stream_corrupt_records_total",
+        "Undecodable records skipped by streaming consumers.", ("topic",))
 
 
 class BrokerClient:
@@ -155,8 +173,9 @@ class NDArrayPublisher:
         self.topic = topic
 
     def publish(self, features, labels) -> None:
-        self.client.send(self.topic,
-                         encode_record(features, labels).encode())
+        payload = encode_record(features, labels).encode()
+        retry_call(self.client.send, self.topic, payload,
+                   policy=_SEND_POLICY, component="kafka_producer")
 
     def flush(self) -> None:
         """Durability point: force out batched sends (see
@@ -195,9 +214,31 @@ class NDArrayPubSubRoute:
 
         def pump():
             import queue as _queue
+            corrupt = _corrupt_counter().labels(topic=self.topic)
             while not self._stop.is_set():
-                for msg in self.client.poll(self.topic, timeout=0.1):
-                    f, l = decode_record(msg.decode())   # decode ONCE
+                try:
+                    # transient broker failures (rebalances, connection
+                    # resets) back off under the shared retry primitive;
+                    # the stop flag aborts the loop promptly via give_up
+                    msgs = retry_call(self.client.poll, self.topic,
+                                      timeout=0.1, policy=_POLL_POLICY,
+                                      component="kafka_consumer",
+                                      give_up=self._stop.is_set)
+                except RetriesExhaustedError:
+                    return              # stop() raced a backoff
+                except Exception as e:  # noqa: BLE001 — fatal poll error
+                    log.error("kafka pump for topic %r stopping on fatal "
+                              "poll error: %s: %s",
+                              self.topic, type(e).__name__, e)
+                    return
+                for msg in msgs:
+                    try:
+                        f, l = decode_record(msg.decode())  # decode ONCE
+                    except Exception:   # noqa: BLE001 — poison message
+                        # a corrupt record must not kill the stream: skip
+                        # it, count it, keep consuming
+                        corrupt.inc()
+                        continue
                     while True:                # backpressure with stop checks
                         try:
                             self.iterator.push(f, l)

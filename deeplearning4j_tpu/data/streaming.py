@@ -26,12 +26,14 @@ import base64
 import json
 import queue
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.resilience.errors import StreamStalledError
 
 
 def encode_record(features: np.ndarray, labels: np.ndarray) -> str:
@@ -70,10 +72,15 @@ class StreamingDataSetIterator(DataSetIterator):
 
     def __init__(self, batch_size: int, buffer_records: int = 1024,
                  drop_remainder: bool = False,
-                 push_timeout: Optional[float] = None):
+                 push_timeout: Optional[float] = None,
+                 stall_timeout: Optional[float] = None):
         self.batch_size = int(batch_size)
         self.drop_remainder = drop_remainder
         self.push_timeout = push_timeout
+        # stall detection: a producer that dies WITHOUT calling end() would
+        # otherwise block the training loop forever in __next__; after this
+        # many silent seconds the consumer gets StreamStalledError instead
+        self.stall_timeout = stall_timeout
         self._q: queue.Queue = queue.Queue(maxsize=buffer_records)
         self._closed = threading.Event()
         self._pending_f: list = []       # consumer-side partial batch
@@ -135,12 +142,20 @@ class StreamingDataSetIterator(DataSetIterator):
         return out
 
     def __next__(self) -> DataSet:
+        last_data = time.monotonic()
         while True:
             if self._n_pending >= self.batch_size:
                 return self._emit(self._pop_batch(self.batch_size))
             got = self._take(block=True)
             if got:
+                last_data = time.monotonic()
                 continue
+            if (self.stall_timeout is not None
+                    and not self._closed.is_set()
+                    and time.monotonic() - last_data > self.stall_timeout):
+                raise StreamStalledError(
+                    f"stream open but silent for over {self.stall_timeout}s "
+                    f"— producer likely died without calling end()")
             if self._closed.is_set() and self._q.empty():
                 # drain any races, then flush the partial tail
                 while self._take(block=False):

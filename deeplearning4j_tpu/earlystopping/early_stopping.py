@@ -227,8 +227,10 @@ class EarlyStoppingTrainer:
                 self.train_data.reset()
             aborted = False
             for batch in self.train_data:
-                self.model._fit_batch(batch if not isinstance(batch, tuple)
-                                      else None or batch)
+                if isinstance(batch, tuple):
+                    from deeplearning4j_tpu.data.dataset import DataSet
+                    batch = DataSet(*batch)
+                self.model._fit_batch(batch)
                 last = self.model.get_score()
                 for c in cfg.iteration_termination_conditions:
                     if c.terminate(last):

@@ -44,6 +44,9 @@ class ComputationGraph:
         self.listeners: List[Any] = []
         self.iteration = 0
         self.epoch = 0
+        self._epoch_batch = 0         # batches consumed in the current epoch
+                                      # (persisted in checkpoints → resume
+                                      # restarts mid-epoch at the right batch)
         self._score = float("nan")
         self._last_input = None       # last fit batch (activation capture)
         self._rnn_carries = None      # rnnTimeStep stateMap
@@ -323,6 +326,7 @@ class ComputationGraph:
         self._last_input = [a[-1] for a in inputs_steps]  # activation capture
         n_steps = int(inputs_steps[0].shape[0])
         self.iteration += n_steps
+        self._epoch_batch += n_steps
         self._score = losses[-1]
         self._mon.record(seconds=time.perf_counter() - t0, steps=n_steps,
                          examples=n_steps * int(inputs_steps[0].shape[1]),
@@ -334,28 +338,96 @@ class ComputationGraph:
                     lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
-    def fit(self, data, labels=None, epochs=1, prefetch=None):
+    def fit(self, data, labels=None, epochs=1, prefetch=None,
+            checkpoint=None, resume_from=None):
         """fit(inputs, labels) | fit(MultiDataSet/DataSet) | fit(iterator).
 
         ``prefetch``: device-resident prefetch depth for the streamed path
         (see data/prefetcher.py and MultiLayerNetwork.fit); ``None`` uses
         the class default ``prefetch_depth``, ``0`` disables. Per-stage
-        timing lands in ``self.last_pipeline_stats``."""
+        timing lands in ``self.last_pipeline_stats``.
+
+        ``checkpoint`` / ``resume_from``: crash-safe periodic saves and
+        bitwise-identical continuation — same contract as
+        MultiLayerNetwork.fit (docs/FAULT_TOLERANCE.md)."""
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-        if labels is not None:
-            return self._fit_batch(MultiDataSet(
-                features=[data] if not isinstance(data, (list, tuple)) else list(data),
-                labels=[labels] if not isinstance(labels, (list, tuple)) else list(labels)))
-        if isinstance(data, DataSet):
-            return self._fit_batch(data.to_multi())
-        if isinstance(data, MultiDataSet):
-            return self._fit_batch(data)
-        for _ in range(epochs):
-            if hasattr(data, "reset"):
-                data.reset()
-            self._fit_stream(data, prefetch=prefetch)
-            self.epoch += 1
-        return self
+
+        ckpt = None
+        if checkpoint is not None:
+            from deeplearning4j_tpu.resilience.checkpoint import (
+                CheckpointListener)
+            ckpt = (checkpoint if isinstance(checkpoint, CheckpointListener)
+                    else CheckpointListener(checkpoint, every_n_epochs=1))
+            self.listeners.append(ckpt)
+        try:
+            direct = (labels is not None
+                      or isinstance(data, (DataSet, MultiDataSet)))
+            if direct:
+                if resume_from is not None:
+                    raise ValueError(
+                        "resume_from needs resettable iterator data; a bare "
+                        "array/DataSet fit has no epoch stream to replay")
+                if labels is not None:
+                    return self._fit_batch(MultiDataSet(
+                        features=[data] if not isinstance(data, (list, tuple))
+                        else list(data),
+                        labels=[labels] if not isinstance(labels, (list, tuple))
+                        else list(labels)))
+                if isinstance(data, DataSet):
+                    return self._fit_batch(data.to_multi())
+                return self._fit_batch(data)
+            n_epochs, skip = epochs, 0
+            if resume_from is not None:
+                if not hasattr(data, "reset"):
+                    raise ValueError(
+                        "resume_from needs a resettable iterator (reset()) "
+                        "to replay the stream to the crash position")
+                skip = self._resume_training(resume_from, data)
+                n_epochs = max(0, epochs - self.epoch)
+            for k in range(n_epochs):
+                if hasattr(data, "reset"):
+                    data.reset()
+                self._fit_stream(data, prefetch=prefetch,
+                                 skip_batches=skip if k == 0 else 0)
+                self.epoch += 1
+                self._epoch_batch = 0
+                for lst in self.listeners:
+                    if hasattr(lst, "on_epoch_end"):
+                        lst.on_epoch_end(self)
+            return self
+        finally:
+            if ckpt is not None:
+                self.listeners.remove(ckpt)
+
+    def _resume_training(self, resume_from, data):
+        """See MultiLayerNetwork._resume_training — restore + wind the
+        iterator to the crash position; returns batches to skip in the
+        first (partial) epoch."""
+        import os as _os
+        from deeplearning4j_tpu.resilience.checkpoint import latest_checkpoint
+        from deeplearning4j_tpu.util.model_serializer import restore_into
+
+        path = _os.fspath(resume_from)
+        if _os.path.isdir(path):
+            found = latest_checkpoint(path)
+            if found is None:
+                raise FileNotFoundError(
+                    f"resume_from: no checkpoints in directory {path}")
+            path = found
+        restore_into(self, path)
+        # one reset() + ONE iter() + full consumption per completed epoch —
+        # the exact call sequence the uninterrupted fit made (a bare
+        # `for _ in iter(data)` would invoke __iter__ twice and de-sync
+        # reset-counting shuffles; see MultiLayerNetwork._resume_training)
+        for _ in range(self.epoch):
+            data.reset()
+            it = iter(data)
+            while True:
+                try:
+                    next(it)
+                except StopIteration:
+                    break
+        return self._epoch_batch
 
     # chunk caps — see MultiLayerNetwork._fit_stream (same design: runs of
     # mask-free same-shape batches stack onto the device-resident scan path)
@@ -383,7 +455,7 @@ class ComputationGraph:
                 host_pp = pp
         return dev_fn, host_pp
 
-    def _stream_chunks(self, data, host_pp, timer):
+    def _stream_chunks(self, data, host_pp, timer, skip_batches=0):
         """Host-side chunk assembly (see MultiLayerNetwork._stream_chunks):
         yields ``("chunk", (xs_list, ys_list))`` stacked host blocks or
         ``("batch", MultiDataSet)`` fallbacks, in base order — chunk
@@ -411,6 +483,13 @@ class ComputationGraph:
             return out
 
         it = iter(data)
+        for _ in range(skip_batches):
+            # resume path: already trained before the crash — pull and drop
+            # so the stream (and any iterator RNG) advances identically
+            try:
+                next(it)
+            except StopIteration:
+                return
         while True:
             t0 = time.perf_counter()
             try:
@@ -459,7 +538,7 @@ class ComputationGraph:
         if out is not None:
             yield out
 
-    def _fit_stream(self, data, prefetch=None):
+    def _fit_stream(self, data, prefetch=None, skip_batches=0):
         """One epoch: host chunk assembly → device-resident prefetch →
         compiled steps (see MultiLayerNetwork._fit_stream for the overlap
         model and stall accounting)."""
@@ -479,7 +558,8 @@ class ComputationGraph:
 
         depth = self.prefetch_depth if prefetch is None else int(prefetch)
         timer = PipelineTimer()
-        stream = self._stream_chunks(data, host_pp, timer)
+        stream = self._stream_chunks(data, host_pp, timer,
+                                     skip_batches=skip_batches)
         if depth > 0:
             stream = DevicePrefetcher(stream, depth=depth, timer=timer)
         it = iter(stream)
@@ -538,6 +618,7 @@ class ComputationGraph:
                                 # get_score() (sync ~100ms on tunneled TPUs)
         self._last_fit_time = time.perf_counter() - t0
         self.iteration += 1
+        self._epoch_batch += 1
         self._mon.record(seconds=self._last_fit_time, steps=1,
                          examples=int(inputs[0].shape[0]), score=self._score,
                          compiled=self._compile_count - c0, path="batch")

@@ -49,6 +49,9 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self.iteration = 0
         self.epoch = 0
+        self._epoch_batch = 0         # batches consumed in the current epoch
+                                      # (persisted in checkpoints → resume
+                                      # restarts mid-epoch at the right batch)
         self._score = float("nan")
         self._last_input = None       # last fit batch (activation capture)
         self._rnn_carries = None      # stored state for rnn_time_step
@@ -308,6 +311,7 @@ class MultiLayerNetwork:
             jnp.asarray(self.iteration, jnp.int32))
         self._last_input = xs[-1]     # device ref for activation capture
         self.iteration += int(xs.shape[0])
+        self._epoch_batch += int(xs.shape[0])
         self._score = losses[-1]
         self._mon.record(seconds=time.perf_counter() - t0,
                          steps=int(xs.shape[0]),
@@ -320,7 +324,8 @@ class MultiLayerNetwork:
                     lst.iteration_done(self, self.iteration, self.epoch)
         return self
 
-    def fit(self, data, labels=None, epochs=1, prefetch=None):
+    def fit(self, data, labels=None, epochs=1, prefetch=None,
+            checkpoint=None, resume_from=None):
         """fit(x, y) | fit(DataSet) | fit(iterator, epochs=N)
         (parity: MultiLayerNetwork.fit :1156).
 
@@ -341,22 +346,90 @@ class MultiLayerNetwork:
         of consumption so the H2D transfer of chunk k+1 overlaps the step
         for chunk k. ``None`` uses the class default ``prefetch_depth``;
         ``0`` disables (naive path — same math, no overlap). Per-stage
-        timing for the last epoch lands in ``self.last_pipeline_stats``."""
+        timing for the last epoch lands in ``self.last_pipeline_stats``.
+
+        ``checkpoint``: crash-safe periodic saves for the duration of this
+        call — a ``resilience.CheckpointListener``, or a directory path
+        (defaults to save-every-epoch into it). ``resume_from``: a
+        checkpoint zip or checkpoint directory (latest taken) — restores
+        params/updater/iteration/epoch/epoch-position and continues the
+        SAME run bitwise-identically: completed epochs are replayed
+        through the iterator (reset + full consumption, so stateful
+        shuffles land where the uninterrupted run left them) and the
+        partial epoch skips the batches already trained. Requires
+        resettable iterator data (docs/FAULT_TOLERANCE.md)."""
         from deeplearning4j_tpu.data.dataset import DataSet
 
-        if labels is not None:
-            return self._fit_batch(DataSet(data, labels))
-        if isinstance(data, DataSet):
-            return self._fit_batch(data)
-        for _ in range(epochs):
-            if hasattr(data, "reset"):
-                data.reset()
-            self._fit_stream(data, prefetch=prefetch)
-            self.epoch += 1
-            for lst in self.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(self)
-        return self
+        ckpt = None
+        if checkpoint is not None:
+            from deeplearning4j_tpu.resilience.checkpoint import (
+                CheckpointListener)
+            ckpt = (checkpoint if isinstance(checkpoint, CheckpointListener)
+                    else CheckpointListener(checkpoint, every_n_epochs=1))
+            self.listeners.append(ckpt)
+        try:
+            if labels is not None or isinstance(data, DataSet):
+                if resume_from is not None:
+                    raise ValueError(
+                        "resume_from needs resettable iterator data; a bare "
+                        "array/DataSet fit has no epoch stream to replay")
+                return self._fit_batch(data if labels is None
+                                       else DataSet(data, labels))
+            n_epochs, skip = epochs, 0
+            if resume_from is not None:
+                if not hasattr(data, "reset"):
+                    raise ValueError(
+                        "resume_from needs a resettable iterator (reset()) "
+                        "to replay the stream to the crash position")
+                skip = self._resume_training(resume_from, data)
+                n_epochs = max(0, epochs - self.epoch)
+            for k in range(n_epochs):
+                if hasattr(data, "reset"):
+                    data.reset()
+                self._fit_stream(data, prefetch=prefetch,
+                                 skip_batches=skip if k == 0 else 0)
+                self.epoch += 1
+                self._epoch_batch = 0
+                for lst in self.listeners:
+                    if hasattr(lst, "on_epoch_end"):
+                        lst.on_epoch_end(self)
+            return self
+        finally:
+            if ckpt is not None:
+                self.listeners.remove(ckpt)
+
+    def _resume_training(self, resume_from, data):
+        """Restore from a checkpoint and wind the iterator forward to where
+        the crashed run stood. Returns the number of batches to skip in the
+        first (partial) epoch."""
+        import os as _os
+        from deeplearning4j_tpu.resilience.checkpoint import latest_checkpoint
+        from deeplearning4j_tpu.util.model_serializer import restore_into
+
+        path = _os.fspath(resume_from)
+        if _os.path.isdir(path):
+            found = latest_checkpoint(path)
+            if found is None:
+                raise FileNotFoundError(
+                    f"resume_from: no checkpoints in directory {path}")
+            path = found
+        restore_into(self, path)
+        # replay completed epochs through the iterator: the uninterrupted
+        # run did reset() (fit loop) + ONE iter() (_stream_chunks) + full
+        # consumption per epoch — stateful iterators (advancing shuffle
+        # RNGs, sampling) must see the identical call sequence to land in
+        # the same state. NB `for _ in iter(data)` would call __iter__
+        # twice (once explicitly, once by the for protocol) and de-sync a
+        # reset-counting shuffle — drive next() by hand instead.
+        for _ in range(self.epoch):
+            data.reset()
+            it = iter(data)
+            while True:
+                try:
+                    next(it)
+                except StopIteration:
+                    break
+        return self._epoch_batch
 
     # chunk cap: bounded host-side staging memory for the stacked block
     _CHUNK_MAX_STEPS = 64
@@ -394,7 +467,7 @@ class MultiLayerNetwork:
                 host_pp = pp      # device-side requested but not expressible
         return dev_fn, host_pp
 
-    def _stream_chunks(self, data, host_pp, timer):
+    def _stream_chunks(self, data, host_pp, timer, skip_batches=0):
         """Host-side stage of the streamed fit pipeline: pull batches,
         stack runs of mask-free same-shape batches into scan chunks.
         Yields ``("chunk", (xs, ys))`` stacked host blocks (np arrays) or
@@ -420,6 +493,14 @@ class MultiLayerNetwork:
             return out
 
         it = iter(data)
+        for _ in range(skip_batches):
+            # resume path: these batches were already trained before the
+            # crash — pull and drop them so the stream (and any iterator
+            # RNG) advances exactly as it did in the uninterrupted run
+            try:
+                next(it)
+            except StopIteration:
+                return
         while True:
             t0 = time.perf_counter()
             try:
@@ -452,7 +533,7 @@ class MultiLayerNetwork:
         if out is not None:
             yield out
 
-    def _fit_stream(self, data, prefetch=None):
+    def _fit_stream(self, data, prefetch=None, skip_batches=0):
         """One epoch over an iterator: host chunk assembly → device-resident
         prefetch → compiled steps. While the device executes chunk k, the
         prefetcher has already dispatched the H2D copy of chunk k+1 and the
@@ -468,7 +549,8 @@ class MultiLayerNetwork:
         dev_fn, host_pp = self._resolve_device_pp(data)
         depth = self.prefetch_depth if prefetch is None else int(prefetch)
         timer = PipelineTimer()
-        stream = self._stream_chunks(data, host_pp, timer)
+        stream = self._stream_chunks(data, host_pp, timer,
+                                     skip_batches=skip_batches)
         if depth > 0:
             stream = DevicePrefetcher(stream, depth=depth, timer=timer)
         it = iter(stream)
@@ -527,6 +609,7 @@ class MultiLayerNetwork:
                                     # tunneled TPU attachments)
         self._last_fit_time = time.perf_counter() - t0
         self.iteration += 1
+        self._epoch_batch += 1
         self._mon.record(seconds=self._last_fit_time, steps=1,
                          examples=int(x.shape[0]), score=self._score,
                          compiled=self._compile_count - c0, path="batch")

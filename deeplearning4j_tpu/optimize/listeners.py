@@ -137,36 +137,39 @@ class EvaluativeListener(IterationListener):
 
 class CheckpointListener(IterationListener):
     """Periodic model checkpoints (parity: CheckpointListener — keeps last N
-    zips in a directory)."""
+    zips in a directory).
+
+    Now a thin shim over ``resilience.checkpoint.CheckpointListener`` —
+    every save is atomic (temp + fsync + os.replace), the directory carries
+    a manifest, and ``keep_every`` pins a sparse long history. Kept under
+    the parity name so existing imports keep working."""
 
     def __init__(self, directory: str, every_n_iterations: Optional[int] = None,
-                 every_n_epochs: Optional[int] = None, keep_last: int = 3):
-        import pathlib
-        self.dir = pathlib.Path(directory)
-        self.dir.mkdir(parents=True, exist_ok=True)
+                 every_n_epochs: Optional[int] = None, keep_last: int = 3,
+                 keep_every: Optional[int] = None):
+        from deeplearning4j_tpu.resilience.checkpoint import (
+            CheckpointListener as _Resilient)
+        self._impl = _Resilient(directory,
+                                every_n_iterations=every_n_iterations,
+                                every_n_epochs=every_n_epochs,
+                                keep_last=keep_last, keep_every=keep_every)
         self.every_n_iterations = every_n_iterations
         self.every_n_epochs = every_n_epochs
         self.keep_last = keep_last
-        self._saved: List = []
 
-    def _save(self, model, tag):
-        path = self.dir / f"checkpoint_{tag}.zip"
-        model.save(str(path))
-        self._saved.append(path)
-        while len(self._saved) > self.keep_last:
-            old = self._saved.pop(0)
-            try:
-                old.unlink()
-            except OSError:
-                pass
+    @property
+    def manager(self):
+        return self._impl.manager
+
+    @property
+    def last_saved_path(self):
+        return self._impl.last_saved_path
 
     def iteration_done(self, model, iteration, epoch):
-        if self.every_n_iterations and iteration % self.every_n_iterations == 0:
-            self._save(model, f"iter_{iteration}")
+        self._impl.iteration_done(model, iteration, epoch)
 
     def on_epoch_end(self, model):
-        if self.every_n_epochs and model.epoch % self.every_n_epochs == 0:
-            self._save(model, f"epoch_{model.epoch}")
+        self._impl.on_epoch_end(model)
 
 
 class TimeIterationListener(IterationListener):

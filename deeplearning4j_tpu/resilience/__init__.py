@@ -1,0 +1,51 @@
+"""Fault tolerance: crash-safe checkpoints, shared retry/backoff, typed
+errors, and a test-only fault-injection harness (docs/FAULT_TOLERANCE.md).
+
+``faults`` is deliberately NOT imported here — it is test-only and stays
+out of production import paths; ``from deeplearning4j_tpu.resilience import
+faults`` explicitly when injecting failures.
+"""
+
+from deeplearning4j_tpu.resilience.errors import (
+    BatcherStoppedError,
+    CorruptCheckpointError,
+    DeadlineExceededError,
+    FatalError,
+    RetriesExhaustedError,
+    ServerOverloadedError,
+    StreamStalledError,
+    TransientError,
+)
+from deeplearning4j_tpu.resilience.retry import (
+    DEFAULT_POLICY,
+    RetryPolicy,
+    default_classifier,
+    retry_call,
+    retryable,
+)
+from deeplearning4j_tpu.resilience.checkpoint import (
+    Checkpoint,
+    CheckpointListener,
+    CheckpointManager,
+    latest_checkpoint,
+)
+
+__all__ = [
+    "BatcherStoppedError",
+    "Checkpoint",
+    "CheckpointListener",
+    "CheckpointManager",
+    "CorruptCheckpointError",
+    "DEFAULT_POLICY",
+    "DeadlineExceededError",
+    "FatalError",
+    "RetriesExhaustedError",
+    "RetryPolicy",
+    "ServerOverloadedError",
+    "StreamStalledError",
+    "TransientError",
+    "default_classifier",
+    "latest_checkpoint",
+    "retry_call",
+    "retryable",
+]

@@ -1,0 +1,74 @@
+"""Typed errors for the resilience layer.
+
+The retry primitive (resilience/retry.py) classifies errors into RETRYABLE
+(transient — worth another attempt after backoff) and FATAL (deterministic —
+retrying cannot help). These classes are the explicit markers; anything else
+is classified structurally (connection/timeout errors are transient, value
+errors are fatal — see ``default_classifier``).
+
+Serving raises the overload/deadline errors below so the HTTP layer can map
+them to status codes (429 / 503 / 504) without string matching, and so
+clients can classify them for their own retry loops.
+"""
+
+from __future__ import annotations
+
+
+class TransientError(Exception):
+    """Always retryable, whatever the classifier says (e.g. a broker poll
+    that failed because a partition was mid-rebalance)."""
+
+
+class FatalError(Exception):
+    """Never retryable (e.g. an auth failure: every attempt will fail the
+    same way, backing off just delays the report)."""
+
+
+class RetriesExhaustedError(Exception):
+    """retry_call gave up: attempts or deadline budget spent. ``__cause__``
+    is the last underlying error; ``attempts`` says how many were made."""
+
+    def __init__(self, message: str, attempts: int = 0,
+                 elapsed: float = 0.0):
+        super().__init__(message)
+        self.attempts = attempts
+        self.elapsed = elapsed
+
+
+class DeadlineExceededError(TimeoutError):
+    """A per-request deadline expired before the work ran. Deliberately NOT
+    retryable: the caller's time budget is spent — retrying would only
+    deliver a late answer nobody is waiting for. Maps to HTTP 504."""
+
+
+class ServerOverloadedError(RuntimeError):
+    """The serving queue is full — load was shed instead of queued. Maps to
+    HTTP 429; RETRYABLE (with backoff) by the default classifier, because
+    overload is transient by definition."""
+
+
+class BatcherStoppedError(RuntimeError):
+    """submit() after stop(): the batcher is draining or gone. Maps to HTTP
+    503 with a ``draining`` health state; not retryable against the same
+    instance."""
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint zip is truncated or damaged. Raised by
+    util/model_serializer.py with the missing/unreadable member named, so a
+    restore failure reads as one actionable message instead of a bare
+    ``KeyError``/``BadZipFile`` from deep inside zipfile."""
+
+    def __init__(self, path, member=None, detail=None):
+        self.path = str(path)
+        self.member = member
+        where = f" (member {member!r})" if member else ""
+        why = f": {detail}" if detail else ""
+        super().__init__(
+            f"corrupt or truncated checkpoint {self.path}{where}{why}")
+
+
+class StreamStalledError(TimeoutError):
+    """A streaming iterator saw no data for longer than ``stall_timeout``
+    while the stream was still nominally open — the producer likely died
+    without calling ``end()``."""

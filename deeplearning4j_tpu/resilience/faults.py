@@ -1,0 +1,178 @@
+"""Test-only fault injection (docs/FAULT_TOLERANCE.md).
+
+Everything here exists to PROVE the resilience layer's claims in
+tests/test_resilience.py rather than to ship in a training loop:
+
+- ``CrashAfter`` — an IterationListener that raises ``SimulatedCrash`` once
+  the iteration counter crosses a threshold, killing a fit mid-epoch from
+  the inside (the fast, in-process stand-in for SIGKILL; the subprocess
+  soak test does the real kill).
+- ``FlakyIterator`` — wraps a DataSetIterator and raises a scripted error
+  on chosen ``next()`` calls (transient or fatal).
+- ``FlakyBroker`` — wraps the in-memory kafka client; scripted poll/send
+  failures and corrupt records exercise the consumer pump's retry + skip
+  paths.
+- ``FlakyEngine`` — wraps an inference engine; scripted delays and
+  failures drive the serving storm tests (expired deadlines, 429s, engine
+  faults → 500).
+
+``SimulatedCrash`` subclasses BaseException on purpose: production code is
+entitled to ``except Exception`` around batches, and a simulated kill must
+not be swallowable by any of it — exactly like a real SIGKILL isn't.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["SimulatedCrash", "CrashAfter", "FlakyIterator", "FlakyBroker",
+           "FlakyEngine"]
+
+
+class SimulatedCrash(BaseException):
+    """An injected process death. BaseException so no ``except Exception``
+    handler between the fit loop and the test can eat it."""
+
+
+class CrashAfter:
+    """IterationListener that crashes the fit once ``iteration >= at_iteration``.
+
+    Order it BEFORE any CheckpointListener in the listeners list so the
+    crash fires before the same iteration gets checkpointed — the resumed
+    run then genuinely re-trains from an older step.
+    """
+
+    def __init__(self, at_iteration: int):
+        self.at_iteration = at_iteration
+        self.fired = False
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        if iteration >= self.at_iteration and not self.fired:
+            self.fired = True
+            raise SimulatedCrash(
+                f"injected crash at iteration {iteration} (epoch {epoch})")
+
+    def on_epoch_end(self, model):
+        pass
+
+
+class FlakyIterator:
+    """Wrap a DataSetIterator; raise ``errors[n]`` on the n-th ``next()``
+    call (0-based, counted across resets). Everything else delegates."""
+
+    def __init__(self, base, errors: Optional[Dict[int, BaseException]] = None):
+        self._base = base
+        self._errors = dict(errors or {})
+        self.calls = 0
+
+    def __iter__(self):
+        iter(self._base)
+        return self
+
+    def __next__(self):
+        n = self.calls
+        self.calls += 1
+        exc = self._errors.pop(n, None)
+        if exc is not None:
+            raise exc
+        return next(self._base)
+
+    def reset(self):
+        self._base.reset()
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+
+class FlakyBroker:
+    """Wrap a kafka-like client: scripted failures on poll/send plus
+    optional corrupt records injected into poll results.
+
+    ``fail_polls`` / ``fail_sends``: {call_index: exception} (0-based).
+    ``corrupt_at``: poll call indices whose records get their payloads
+    replaced with garbage bytes (undecodable by ``decode_record``).
+    """
+
+    def __init__(self, base, fail_polls: Optional[Dict[int, BaseException]] = None,
+                 fail_sends: Optional[Dict[int, BaseException]] = None,
+                 corrupt_at: Optional[set] = None):
+        self._base = base
+        self._fail_polls = dict(fail_polls or {})
+        self._fail_sends = dict(fail_sends or {})
+        self._corrupt_at = set(corrupt_at or ())
+        self.poll_calls = 0
+        self.send_calls = 0
+
+    def poll(self, *args, **kwargs):
+        n = self.poll_calls
+        self.poll_calls += 1
+        exc = self._fail_polls.pop(n, None)
+        if exc is not None:
+            raise exc
+        records = self._base.poll(*args, **kwargs)
+        if n in self._corrupt_at and records:
+            records = [type(r)(*[b"\x00garbage" if isinstance(v, bytes) else v
+                                 for v in r]) if isinstance(r, tuple)
+                       else b"\x00garbage" for r in records]
+        return records
+
+    def send(self, *args, **kwargs):
+        n = self.send_calls
+        self.send_calls += 1
+        exc = self._fail_sends.pop(n, None)
+        if exc is not None:
+            raise exc
+        return self._base.send(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+
+class FlakyEngine:
+    """Wrap an inference engine for serving storm tests.
+
+    ``delay``: seconds to sleep inside every ``predict`` (makes the
+    micro-batcher queue fill so 429/deadline paths are reachable).
+    ``fail_calls``: {call_index: exception} raised instead of predicting.
+    ``gate``: optional threading.Event — when set, predict blocks on it
+    before running, letting a test hold the device "busy" deterministically.
+    """
+
+    def __init__(self, base, delay: float = 0.0,
+                 fail_calls: Optional[Dict[int, BaseException]] = None,
+                 gate: Optional[threading.Event] = None):
+        self._base = base
+        self.delay = delay
+        self._fail_calls = dict(fail_calls or {})
+        self.gate = gate
+        self.calls = 0
+        self.rows_seen = 0
+
+    def _intercept(self, x):
+        n = self.calls
+        self.calls += 1
+        if self.gate is not None:
+            self.gate.wait()
+        if self.delay > 0:
+            time.sleep(self.delay)
+        exc = self._fail_calls.pop(n, None)
+        if exc is not None:
+            raise exc
+        try:
+            self.rows_seen += int(x.shape[0])
+        except Exception:
+            pass
+
+    def predict_host(self, x, *args, **kwargs):
+        """The micro-batcher's entry point."""
+        self._intercept(x)
+        return self._base.predict_host(x, *args, **kwargs)
+
+    def predict(self, x, *args, **kwargs):
+        self._intercept(x)
+        return self._base.predict(x, *args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)

@@ -1,0 +1,182 @@
+"""Shared retry primitive: bounded exponential backoff + decorrelated jitter.
+
+One retry loop for the whole framework — the kafka pump, the remote
+fetchers, the UI reporter and the serving/KNN HTTP clients all call
+``retry_call`` instead of hand-rolling ``for _ in range(n)`` loops, so
+every remote interaction gets the same semantics:
+
+- **bounded attempts** (``max_attempts``) and an optional overall
+  **deadline** in seconds — the loop never sleeps past the point where the
+  budget is already spent;
+- **decorrelated jitter** (the AWS architecture-blog variant):
+  ``delay = min(max_delay, uniform(base_delay, prev_delay * 3))`` — grows
+  roughly exponentially but desynchronizes a thundering herd of clients
+  retrying against the same recovering endpoint;
+- **classification**: ``TransientError`` / connection / timeout errors are
+  retried, ``FatalError`` / value-type errors are raised immediately
+  (retrying a deterministic failure only delays the report);
+- **metrics**: every attempt lands in
+  ``dl4jtpu_retry_attempts_total{component, outcome}`` and every backoff
+  sleep in ``dl4jtpu_retry_backoff_seconds`` — GET /metrics shows which
+  dependency is flapping, fleet-wide.
+
+The clock, sleeper and RNG are injectable so tests drive the policy with a
+fake clock (tests/test_resilience.py) — no real sleeping in tier-1.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.resilience.errors import (
+    DeadlineExceededError, FatalError, RetriesExhaustedError,
+    ServerOverloadedError, TransientError)
+
+__all__ = ["RetryPolicy", "retry_call", "retryable", "default_classifier"]
+
+
+def default_classifier(exc: BaseException) -> bool:
+    """True = transient (retry), False = fatal (raise now).
+
+    Explicit markers win; otherwise network-shaped errors (connection
+    resets, timeouts, DNS/socket failures) are transient and everything
+    else — type errors, value errors, missing files — is fatal."""
+    if isinstance(exc, (TransientError, ServerOverloadedError)):
+        return True
+    if isinstance(exc, (FatalError, DeadlineExceededError)):
+        return False
+    # late import keeps urllib out of the hot path for non-HTTP users
+    import urllib.error
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code in (429, 502, 503, 504)
+    if isinstance(exc, (ConnectionError, TimeoutError, socket.timeout,
+                        urllib.error.URLError, BrokenPipeError)):
+        return True
+    return False
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Knobs for one call-site's retry behavior (docs/FAULT_TOLERANCE.md).
+
+    ``max_attempts``: total tries including the first (``None`` = unbounded,
+    pair it with ``deadline`` or a ``give_up`` callback).
+    ``base_delay``/``max_delay``: backoff bounds in seconds.
+    ``deadline``: overall wall budget across attempts, in seconds.
+    ``classify``: error → retryable? (default ``default_classifier``)."""
+
+    max_attempts: Optional[int] = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    deadline: Optional[float] = None
+    classify: Callable[[BaseException], bool] = default_classifier
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+# backoff sleep buckets: 10ms jitter floor through the 30s circuit-breaker
+# scale (coarser than request latency — backoff is seconds, not micros)
+_BACKOFF_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0)
+
+
+def _metrics():
+    reg = get_registry()
+    return (reg.counter(
+                "dl4jtpu_retry_attempts_total",
+                "Attempts made by the shared retry primitive. outcome: "
+                "success | error (will retry) | exhausted (gave up) | "
+                "fatal (not retryable).",
+                ("component", "outcome")),
+            reg.histogram(
+                "dl4jtpu_retry_backoff_seconds",
+                "Backoff sleeps taken between retry attempts.",
+                ("component",), buckets=_BACKOFF_BUCKETS))
+
+
+def retry_call(fn, *args, policy: RetryPolicy = DEFAULT_POLICY,
+               component: str = "default",
+               give_up: Optional[Callable[[], bool]] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               clock: Callable[[], float] = time.monotonic,
+               rng: Optional[random.Random] = None, **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying transient failures under
+    ``policy``. ``give_up()`` is polled before every attempt and before
+    every sleep — a shutdown flag aborts the loop promptly (raising
+    ``RetriesExhaustedError``). Raises the original error for fatal
+    failures, ``RetriesExhaustedError`` (with ``__cause__``) otherwise."""
+    attempts_total, backoff_hist = _metrics()
+    rng = rng if rng is not None else random
+    start = clock()
+    prev_delay = policy.base_delay
+    attempt = 0
+    last_exc: Optional[BaseException] = None
+    while True:
+        if give_up is not None and give_up():
+            raise RetriesExhaustedError(
+                f"{component}: aborted by give_up() after {attempt} "
+                f"attempt(s)", attempts=attempt,
+                elapsed=clock() - start) from last_exc
+        attempt += 1
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — classified right below
+            last_exc = e
+            if not policy.classify(e):
+                attempts_total.labels(component=component,
+                                      outcome="fatal").inc()
+                raise
+            elapsed = clock() - start
+            out_of_attempts = (policy.max_attempts is not None
+                               and attempt >= policy.max_attempts)
+            out_of_time = (policy.deadline is not None
+                           and elapsed >= policy.deadline)
+            if out_of_attempts or out_of_time:
+                attempts_total.labels(component=component,
+                                      outcome="exhausted").inc()
+                why = ("deadline" if out_of_time else "attempts")
+                raise RetriesExhaustedError(
+                    f"{component}: {why} budget spent after {attempt} "
+                    f"attempt(s) in {elapsed:.3f}s: "
+                    f"{type(e).__name__}: {e}",
+                    attempts=attempt, elapsed=elapsed) from e
+            attempts_total.labels(component=component,
+                                  outcome="error").inc()
+            delay = min(policy.max_delay,
+                        rng.uniform(policy.base_delay, prev_delay * 3.0))
+            prev_delay = delay
+            if policy.deadline is not None:
+                remaining = policy.deadline - (clock() - start)
+                if remaining <= 0 or delay >= remaining:
+                    # sleeping would only carry us past the budget — one
+                    # last immediate attempt is still within it, so take
+                    # the largest sleep that is not
+                    delay = max(0.0, remaining - 1e-3)
+            if give_up is not None and give_up():
+                continue        # top-of-loop raises with the abort message
+            if delay > 0:
+                backoff_hist.labels(component=component).observe(delay)
+                sleep(delay)
+        else:
+            attempts_total.labels(component=component,
+                                  outcome="success").inc()
+            return result
+
+
+def retryable(policy: RetryPolicy = DEFAULT_POLICY,
+              component: str = "default"):
+    """Decorator form: ``@retryable(policy, component="fetcher")``."""
+    def wrap(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy,
+                              component=component, **kwargs)
+        return inner
+    return wrap

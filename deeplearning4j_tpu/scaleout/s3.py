@@ -115,11 +115,19 @@ class S3Downloader:
     prefixes) down; ``download_dataset`` lands them in the fetcher cache so
     load_mnist/load_cifar10 switch from synthetic to real data."""
 
-    def __init__(self, store: Optional[ObjectStore] = None):
+    def __init__(self, store: Optional[ObjectStore] = None,
+                 retry_policy=None):
+        from deeplearning4j_tpu.resilience.retry import RetryPolicy
         self.store = store if store is not None else S3ObjectStore()
+        # transient store failures (throttling, connection resets) back off
+        # under the shared primitive; FileNotFoundError stays fatal
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=4, base_delay=0.1, max_delay=5.0)
 
     def download(self, bucket: str, key: str, local_path) -> Path:
-        return self.store.download(bucket, key, local_path)
+        from deeplearning4j_tpu.resilience.retry import retry_call
+        return retry_call(self.store.download, bucket, key, local_path,
+                          policy=self.retry_policy, component="fetcher")
 
     def download_prefix(self, bucket: str, prefix: str, local_dir) -> List[Path]:
         """Download every object under ``prefix`` into ``local_dir``,
@@ -139,7 +147,7 @@ class S3Downloader:
                 rel = key[len(p) + 1:]
             else:          # char-prefix match past the / boundary
                 rel = key
-            out.append(self.store.download(bucket, key, local_dir / rel))
+            out.append(self.download(bucket, key, local_dir / rel))
         return out
 
     def download_dataset(self, bucket: str, prefix: str,

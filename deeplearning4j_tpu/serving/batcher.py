@@ -8,9 +8,19 @@ the merged result. Combined with the engine's shape buckets, a storm of
 odd-sized requests becomes a steady stream of identically-shaped device
 calls that never trigger a fresh XLA compile.
 
-Backpressure: the queue is bounded; ``submit`` blocks (up to
-``submit_timeout``) when serving falls behind, which is the knob that keeps
-a traffic spike from growing the heap without bound.
+Overload protection (docs/FAULT_TOLERANCE.md):
+
+- the queue is bounded; ``submit(block=False)`` sheds load immediately with
+  ``ServerOverloadedError`` (HTTP 429 upstairs) instead of blocking a
+  handler thread, and blocking submits still time out;
+- each request can carry a **deadline**; expired requests are answered
+  fast with ``DeadlineExceededError`` — at pop AND again right before
+  dispatch, so an expired request never rides a device call;
+- ``stop()`` drains gracefully: no new submits (``BatcherStoppedError``,
+  immediately), the worker flushes everything already queued, then exits —
+  and the stopping flag flips under the same lock that gates every
+  enqueue, so a racing ``submit`` either lands before the drain (and is
+  flushed) or is rejected; no Future is ever left unresolved.
 """
 
 from __future__ import annotations
@@ -26,6 +36,8 @@ import numpy as np
 
 from deeplearning4j_tpu.monitor import (
     DEFAULT_LATENCY_BUCKETS, get_registry, trace)
+from deeplearning4j_tpu.resilience.errors import (
+    BatcherStoppedError, DeadlineExceededError, ServerOverloadedError)
 
 
 class MicroBatcher:
@@ -46,10 +58,14 @@ class MicroBatcher:
         self.engine = engine
         self.max_batch = int(max_batch)
         self.max_latency_ms = float(max_latency_ms)
+        self.max_queue = int(max_queue)
         self.submit_timeout = submit_timeout
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
+        self._stopping = threading.Event()
+        # gates every enqueue AND the stopping-flag flip: a submit can
+        # never slip into the queue after stop() started rejecting
+        self._state_lock = threading.Lock()
         # serving counters live in the process-wide registry: /stats, the
         # bench snapshots and GET /metrics all read the same cells
         self.id = f"batcher{next(MicroBatcher._ids)}"
@@ -66,6 +82,17 @@ class MicroBatcher:
             "dl4jtpu_serving_device_calls_total",
             "Merged device calls issued (avg merge = requests / calls).",
             ("batcher",)).labels(**lab)
+        rejected = reg.counter(
+            "dl4jtpu_serving_rejected_total",
+            "Requests shed instead of served. reason: queue_full (429) | "
+            "stopped (503) | deadline (504, answered before any device "
+            "call).", ("batcher", "reason"))
+        self._m_rej_full = rejected.labels(batcher=self.id,
+                                           reason="queue_full")
+        self._m_rej_stopped = rejected.labels(batcher=self.id,
+                                              reason="stopped")
+        self._m_rej_deadline = rejected.labels(batcher=self.id,
+                                               reason="deadline")
         self._m_latency = reg.histogram(
             "dl4jtpu_serving_request_latency_seconds",
             "End-to-end request latency: submit() to future resolution "
@@ -78,64 +105,138 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "MicroBatcher":
+        """Start (or explicitly restart after stop()) the worker."""
         if self._thread is not None:
             return self
-        self._stop.clear()
+        self._stopping.clear()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
         return self
 
     def stop(self) -> None:
-        self._stop.set()
+        """Graceful drain: stop accepting, flush everything in-flight, join.
+        Every queued Future settles — with its result if the worker reaches
+        it, never by being silently dropped."""
+        with self._state_lock:
+            self._stopping.set()
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            self._thread.join(timeout=30.0)
             self._thread = None
-        # fail anything still queued so callers don't hang on dead futures
+        # anything still queued (worker never ran / join timed out): settle
+        # it. New submits can't land — stopping is set under the lock.
+        with self._state_lock:
+            self._reject_queued()
+
+    def _reject_queued(self):
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
-                break
-            item[1].set_exception(RuntimeError("micro-batcher stopped"))
+                return
+            if not item[1].done():
+                self._m_rej_stopped.inc()
+                item[1].set_exception(
+                    BatcherStoppedError("micro-batcher stopped"))
+
+    @property
+    def stopping(self) -> bool:
+        return self._stopping.is_set()
 
     # -------------------------------------------------------------- serving
-    def submit(self, x) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               block: bool = True) -> Future:
         """Queue a request batch (n, features...); returns a Future whose
-        result is the (n, ...) output slice. Blocks when the queue is full
-        (bounded-queue backpressure)."""
-        if self._thread is None:
-            self.start()
-        x = np.asarray(x)
-        fut: Future = Future()
-        with trace.span("enqueue", rows=int(x.shape[0])):
-            self._q.put((x, fut, time.perf_counter()),
-                        timeout=self.submit_timeout)
-        return fut
+        result is the (n, ...) output slice.
 
-    def predict(self, x):
+        ``deadline_ms``: per-request budget from NOW; once expired the
+        request is answered with ``DeadlineExceededError`` without touching
+        the device. ``block=False``: never wait on a full queue — raise
+        ``ServerOverloadedError`` immediately (the HTTP 429 path). Blocking
+        submits apply backpressure up to ``submit_timeout`` seconds, then
+        raise the same. Raises ``BatcherStoppedError`` once ``stop()`` has
+        begun — a post-stop submit fails fast instead of hanging forever.
+        """
+        x = np.asarray(x)
+        t0 = time.perf_counter()
+        expires = None if deadline_ms is None else t0 + deadline_ms / 1000.0
+        fut: Future = Future()
+        item = (x, fut, t0, expires)
+        give_up_at = (None if self.submit_timeout is None
+                      else t0 + self.submit_timeout)
+        with trace.span("enqueue", rows=int(x.shape[0])):
+            while True:
+                with self._state_lock:
+                    if self._stopping.is_set():
+                        self._m_rej_stopped.inc()
+                        raise BatcherStoppedError(
+                            "micro-batcher is draining/stopped; "
+                            "submit() rejected")
+                    if self._thread is None:
+                        self.start()
+                    try:
+                        self._q.put_nowait(item)
+                        return fut
+                    except queue.Full:
+                        pass
+                if not block or (give_up_at is not None
+                                 and time.perf_counter() >= give_up_at):
+                    self._m_rej_full.inc()
+                    raise ServerOverloadedError(
+                        f"serving queue full ({self.max_queue} waiting); "
+                        "load shed")
+                time.sleep(0.002)    # bounded backpressure, stop-aware
+
+    def predict(self, x, deadline_ms: Optional[float] = None):
         """Synchronous convenience: submit + wait."""
-        return self.submit(x).result()
+        return self.submit(x, deadline_ms=deadline_ms).result()
+
+    # --------------------------------------------------------------- worker
+    def _expired(self, item, now) -> bool:
+        """Settle an expired request with DeadlineExceededError. True if it
+        was expired (caller drops it from the batch)."""
+        expires = item[3]
+        if expires is None or now < expires:
+            return False
+        if not item[1].done():
+            self._m_rej_deadline.inc()
+            item[1].set_exception(DeadlineExceededError(
+                "request deadline expired before dispatch "
+                f"({(now - item[2]) * 1e3:.1f} ms in queue)"))
+        return True
 
     def _worker(self):
-        while not self._stop.is_set():
+        while True:
             try:
                 first = self._q.get(timeout=0.05)
             except queue.Empty:
+                if self._stopping.is_set():
+                    return      # graceful exit: queue fully flushed
+                continue
+            if self._expired(first, time.perf_counter()):
                 continue
             batch = [first]
             total = first[0].shape[0]
-            deadline = time.perf_counter() + self.max_latency_ms / 1000.0
+            wait_until = time.perf_counter() + self.max_latency_ms / 1000.0
             while total < self.max_batch:
-                remaining = deadline - time.perf_counter()
+                remaining = wait_until - time.perf_counter()
                 try:
                     item = (self._q.get_nowait() if remaining <= 0
                             else self._q.get(timeout=remaining))
                 except queue.Empty:
                     break
+                if self._expired(item, time.perf_counter()):
+                    continue
                 batch.append(item)
                 total += item[0].shape[0]
                 if remaining <= 0:
                     break
+            # final deadline check at dispatch time: a request that expired
+            # while waiting for co-travellers must NOT ride the device call
+            now = time.perf_counter()
+            batch = [it for it in batch if not self._expired(it, now)]
+            if not batch:
+                continue
+            total = sum(it[0].shape[0] for it in batch)
             try:
                 merged = (batch[0][0] if len(batch) == 1
                           else np.concatenate([b[0] for b in batch]))
@@ -144,7 +245,7 @@ class MicroBatcher:
                     out = out[0]
                 ofs = 0
                 done = time.perf_counter()
-                for x, fut, t0 in batch:
+                for x, fut, t0, _ in batch:
                     fut.set_result(out[ofs:ofs + x.shape[0]])
                     self._m_latency.observe(done - t0)
                     ofs += x.shape[0]
@@ -171,6 +272,12 @@ class MicroBatcher:
     def n_device_calls(self) -> int:
         return int(self._m_device_calls.value)
 
+    @property
+    def n_rejected(self) -> dict:
+        return {"queue_full": int(self._m_rej_full.value),
+                "stopped": int(self._m_rej_stopped.value),
+                "deadline": int(self._m_rej_deadline.value)}
+
     def stats(self) -> dict:
         calls = self.n_device_calls
         p50 = self._m_latency.percentile(0.5)
@@ -180,6 +287,9 @@ class MicroBatcher:
                 "device_calls": calls,
                 "avg_merge": (self.n_requests / calls) if calls else 0.0,
                 "queue_depth": self._q.qsize(),
+                "queue_capacity": self.max_queue,
+                "rejected": self.n_rejected,
+                "state": "draining" if self.stopping else "serving",
                 "latency_p50_ms": None if p50 is None else p50 * 1e3,
                 "latency_p99_ms": None if p99 is None else p99 * 1e3,
                 "max_batch": self.max_batch,

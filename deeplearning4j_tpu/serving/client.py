@@ -1,23 +1,66 @@
-"""Client for the InferenceServer (JSON + Base64 f32, knn_server style)."""
+"""Client for the InferenceServer (JSON + Base64 f32, knn_server style).
+
+Error mapping mirrors the server's status codes (docs/FAULT_TOLERANCE.md):
+429 → ServerOverloadedError (retryable — the shared retry primitive backs
+off and tries again), 503 → BatcherStoppedError (draining; not retryable
+against this instance), 504 → DeadlineExceededError (the request's own
+budget is spent; retrying would deliver a late answer), 400 → ValueError
+(the payload is wrong; identical on every attempt), 500 → RuntimeError.
+Connection failures retry under the same policy.
+"""
 
 from __future__ import annotations
 
 import json
 import urllib.error
 import urllib.request
+from typing import Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.clustering.knn_server import (
     ndarray_from_b64, ndarray_to_b64)
+from deeplearning4j_tpu.resilience.errors import (
+    BatcherStoppedError, DeadlineExceededError, ServerOverloadedError)
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+
+
+def _error_message(e: urllib.error.HTTPError) -> str:
+    """Best-effort extraction of the structured error body
+    ({"error": {"type", "message"}} — or the legacy plain string)."""
+    try:
+        out = json.loads(e.read().decode())
+        err = out.get("error")
+        if isinstance(err, dict):
+            return str(err.get("message", err))
+        if err is not None:
+            return str(err)
+    except Exception:   # noqa: BLE001 — body unreadable; code still speaks
+        pass
+    return f"HTTP {e.code}"
+
+
+def _typed_http_error(e: urllib.error.HTTPError) -> Exception:
+    msg = _error_message(e)
+    if e.code == 429:
+        return ServerOverloadedError(msg)
+    if e.code == 503:
+        return BatcherStoppedError(msg)
+    if e.code == 504:
+        return DeadlineExceededError(msg)
+    if 400 <= e.code < 500:
+        return ValueError(msg)
+    return RuntimeError(msg)
 
 
 class InferenceClient:
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(self, url: str, timeout: float = 30.0, retries: int = 3):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.retry_policy = RetryPolicy(max_attempts=max(1, retries),
+                                        base_delay=0.05, max_delay=1.0)
 
-    def _request(self, path, payload=None):
+    def _once(self, path, payload):
         if payload is None:
             req = urllib.request.Request(self.url + path)
         else:
@@ -28,19 +71,31 @@ class InferenceClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 out = json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
-            try:
-                out = json.loads(e.read().decode())
-            except Exception:
-                raise RuntimeError(f"HTTP {e.code}") from e
+            raise _typed_http_error(e) from e
         if isinstance(out, dict) and "error" in out:
-            raise RuntimeError(out["error"])
+            err = out["error"]
+            raise RuntimeError(err.get("message", str(err))
+                               if isinstance(err, dict) else err)
         return out
 
-    def predict(self, x) -> np.ndarray:
+    def _request(self, path, payload=None):
+        # overload (429) and connection failures retry with backoff; 4xx
+        # payload errors and expired deadlines surface immediately
+        return retry_call(self._once, path, payload,
+                          policy=self.retry_policy,
+                          component="serving_client")
+
+    def predict(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
         """POST one request batch; a 1-D vector is treated as batch of 1
-        and the batch dim stripped from the reply (server mirrors this)."""
-        out = self._request(
-            "/predict", {"ndarray": ndarray_to_b64(np.asarray(x))})
+        and the batch dim stripped from the reply (server mirrors this).
+
+        ``deadline_ms``: per-request budget, enforced server-side through
+        the micro-batcher — an expired request is answered 504 fast instead
+        of riding a device call."""
+        payload = {"ndarray": ndarray_to_b64(np.asarray(x))}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        out = self._request("/predict", payload)
         return ndarray_from_b64(out["ndarray"])
 
     def warmup(self, input_shape, max_batch=None) -> dict:
@@ -50,6 +105,15 @@ class InferenceClient:
         if max_batch is not None:
             payload["max_batch"] = int(max_batch)
         return self._request("/warmup", payload)
+
+    def health(self) -> dict:
+        """GET /healthz — {"status": "ok" | "degraded" | "draining"}.
+        A draining server answers 503 (load balancers pull it from
+        rotation); that still reads as a status here, not an error."""
+        try:
+            return self._once("/healthz", None)
+        except BatcherStoppedError:
+            return {"status": "draining"}
 
     def stats(self) -> dict:
         return self._request("/stats")

@@ -6,11 +6,21 @@ each POST /predict rides the micro-batcher, so concurrent HTTP clients are
 coalesced into shared device calls. Wire format in docs/SERVING.md.
 
 Endpoints:
-  POST /predict  {"ndarray": {shape, data}}          → {"ndarray": ...}
-  POST /warmup   {"input_shape": [...], "max_batch"} → {"buckets": [...]}
-  GET  /stats                                        → engine+batcher stats
-  GET  /metrics                                      → Prometheus text
-  GET  /healthz                                      → {"status": "ok"}
+  POST /predict  {"ndarray": {shape, data}, "deadline_ms"?} → {"ndarray": ...}
+  POST /warmup   {"input_shape": [...], "max_batch"}        → {"buckets": [...]}
+  GET  /stats                                               → engine+batcher stats
+  GET  /metrics                                             → Prometheus text
+  GET  /healthz                                             → {"status": ...}
+
+Error contract (docs/FAULT_TOLERANCE.md): every error body is structured —
+``{"error": {"type": ..., "message": ...}}`` — and the status code
+classifies it: **400** malformed payload (bad JSON, missing ``ndarray``,
+wrong rank/feature width), **429** queue full (shed immediately, the
+handler thread never blocks on a full queue), **503** draining/stopped,
+**504** request deadline expired (answered without riding a device call),
+**500** engine faults only. ``/healthz`` reports ``ok`` | ``degraded``
+(queue ≥ 80% full or a recent engine fault) | ``draining`` (status 503, so
+load balancers pull the instance while in-flight work flushes).
 """
 
 from __future__ import annotations
@@ -22,9 +32,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import urlparse
 
+import numpy as np
+
 from deeplearning4j_tpu.clustering.knn_server import (
     ndarray_from_b64, ndarray_to_b64)
 from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.resilience.errors import (
+    BatcherStoppedError, DeadlineExceededError, ServerOverloadedError)
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 
@@ -41,6 +55,10 @@ def _http_metrics():
                           ("path",)))
 
 
+class BadRequestError(ValueError):
+    """Client-side payload problem → HTTP 400 (never 500)."""
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
@@ -52,6 +70,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _error(self, code: int, err_type: str, message: str):
+        self._json({"error": {"type": err_type, "message": message}}, code)
 
     def _text(self, body: str, content_type: str, code=200):
         data = body.encode()
@@ -81,12 +102,14 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/stats":
                 self._json(srv.stats())
             elif path == "/healthz":
-                self._json({"status": "ok"})
+                status = srv.health()
+                self._json({"status": status},
+                           503 if status == "draining" else 200)
             elif path == "/metrics":
                 self._text(get_registry().render(),
                            "text/plain; version=0.0.4; charset=utf-8")
             else:
-                self._json({"error": "not found"}, 404)
+                self._error(404, "not_found", f"no such path: {path}")
 
         self._observed(path, handle)
 
@@ -96,22 +119,22 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         try:
             payload = json.loads(self.rfile.read(n).decode())
-        except Exception as e:
-            self._json({"error": f"bad json: {e}"}, 400)
+            if not isinstance(payload, dict):
+                raise ValueError("payload must be a JSON object")
+        except Exception as e:  # noqa: BLE001 — client sent junk
+            self._error(400, "bad_request", f"bad json: {e}")
             return
 
         def handle():
             try:
                 if path == "/predict":
-                    x = ndarray_from_b64(payload["ndarray"])
-                    if x.ndim == 1:
-                        x = x[None, :]
-                        out = srv.batcher.predict(x)[0]
-                    else:
-                        out = srv.batcher.predict(x)
-                    self._json({"ndarray": ndarray_to_b64(out)})
+                    self._predict(srv, payload)
                 elif path == "/warmup":
-                    shape = payload["input_shape"]
+                    try:
+                        shape = payload["input_shape"]
+                    except KeyError:
+                        raise BadRequestError(
+                            "payload missing 'input_shape'") from None
                     shapes = ([tuple(s) for s in shape]
                               if shape and isinstance(shape[0], list)
                               else tuple(shape))
@@ -120,11 +143,50 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json({"buckets": buckets,
                                 "seconds": srv.engine.warmup_seconds})
                 else:
-                    self._json({"error": "not found"}, 404)
-            except Exception as e:  # noqa: BLE001 — service must answer
-                self._json({"error": str(e)}, 500)
+                    self._error(404, "not_found", f"no such path: {path}")
+            except BadRequestError as e:
+                self._error(400, "bad_request", str(e))
+            except ServerOverloadedError as e:
+                self._error(429, "overloaded", str(e))
+            except BatcherStoppedError as e:
+                self._error(503, "draining", str(e))
+            except DeadlineExceededError as e:
+                self._error(504, "deadline_exceeded", str(e))
+            except Exception as e:  # noqa: BLE001 — engine fault: 500
+                srv.note_engine_error(e)
+                self._error(500, "internal",
+                            f"{type(e).__name__}: {e}")
 
         self._observed(path, handle)
+
+    def _predict(self, srv, payload):
+        try:
+            raw = payload["ndarray"]
+        except KeyError:
+            raise BadRequestError("payload missing 'ndarray'") from None
+        try:
+            x = ndarray_from_b64(raw)
+        except Exception as e:  # noqa: BLE001 — undecodable client bytes
+            raise BadRequestError(f"undecodable ndarray: {e}") from None
+        deadline_ms = payload.get("deadline_ms", srv.request_timeout_ms)
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                raise BadRequestError(
+                    f"deadline_ms must be a number, got "
+                    f"{payload.get('deadline_ms')!r}") from None
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = x[None, :]
+        srv.validate_features(x)
+        # block=False: a full queue answers 429 NOW — the handler thread is
+        # never parked on backpressure while the client waits
+        fut = srv.batcher.submit(x, deadline_ms=deadline_ms, block=False)
+        out = fut.result()
+        if squeeze:
+            out = out[0]
+        self._json({"ndarray": ndarray_to_b64(out)})
 
 
 class InferenceServer:
@@ -132,23 +194,67 @@ class InferenceServer:
 
         srv = InferenceServer(net, port=0).start()
         out = InferenceClient(f"http://localhost:{srv.port}").predict(x)
+
+    ``max_queue``: bound on queued requests (beyond it: HTTP 429).
+    ``request_timeout_ms``: default per-request deadline when the client
+    does not send ``deadline_ms`` (None = no deadline).
     """
 
     def __init__(self, model, port: int = 9300, host: str = "127.0.0.1",
                  max_batch: int = 256, max_latency_ms: float = 2.0,
-                 engine: Optional[InferenceEngine] = None):
+                 engine: Optional[InferenceEngine] = None,
+                 max_queue: int = 1024,
+                 request_timeout_ms: Optional[float] = None):
         self.engine = engine or InferenceEngine(model)
         self.batcher = MicroBatcher(self.engine, max_batch=max_batch,
-                                    max_latency_ms=max_latency_ms)
+                                    max_latency_ms=max_latency_ms,
+                                    max_queue=max_queue)
+        self.request_timeout_ms = request_timeout_ms
         self._port_req = port
         self._host = host
         self._httpd = None
         self.port: Optional[int] = None
+        self._draining = threading.Event()
+        self.last_error: Optional[str] = None
+        self._m_engine_errors = get_registry().counter(
+            "dl4jtpu_serving_engine_errors_total",
+            "Engine faults surfaced as HTTP 500 by the inference server.")
+
+    # --------------------------------------------------------------- health
+    def note_engine_error(self, e: BaseException) -> None:
+        self.last_error = f"{type(e).__name__}: {e}"
+        self._m_engine_errors.inc()
+
+    def validate_features(self, x: np.ndarray) -> None:
+        """400 for wrong rank / feature width when the model's conf declares
+        a fixed input type (feed-forward feature count)."""
+        itype = getattr(getattr(self.engine, "model", None), "conf", None)
+        itype = getattr(itype, "input_type", None)
+        if itype is None or getattr(itype, "kind", None) not in (
+                "ff", "cnn_flat"):
+            return
+        expected = itype.batch_shape(1)
+        if x.ndim != len(expected) or x.shape[1:] != expected[1:]:
+            raise BadRequestError(
+                f"input shape {tuple(x.shape)} does not match model input "
+                f"(batch, {', '.join(str(d) for d in expected[1:])})")
+
+    def health(self) -> str:
+        if self._draining.is_set() or self.batcher.stopping:
+            return "draining"
+        st = self.batcher.stats()
+        if st["queue_capacity"] and (st["queue_depth"]
+                                     >= 0.8 * st["queue_capacity"]):
+            return "degraded"
+        return "ok"
 
     def stats(self) -> dict:
         return {"engine": self.engine.stats(),
-                "batcher": self.batcher.stats()}
+                "batcher": self.batcher.stats(),
+                "health": self.health(),
+                "last_error": self.last_error}
 
+    # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceServer":
         self.batcher.start()
         self._httpd = ThreadingHTTPServer((self._host, self._port_req),
@@ -160,7 +266,11 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
+        """Graceful drain: flag draining (healthz → 503, LBs pull us), let
+        the batcher flush everything already queued, then close the HTTP
+        listener. Requests arriving mid-drain get fast 503s, not hangs."""
+        self._draining.set()
+        self.batcher.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
-        self.batcher.stop()

@@ -29,6 +29,7 @@ import queue
 import threading
 import time
 
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 from deeplearning4j_tpu.ui.storage import RemoteUIStatsStorageRouter
 
 
@@ -44,6 +45,11 @@ class WebReporter:
                  retries: int = 3, timeout: float = 2.0):
         self._router = RemoteUIStatsStorageRouter(base_url, timeout=timeout)
         self.retries = retries
+        # UI delivery is best-effort: retry EVERY failure (the old loop's
+        # semantics) but now with backoff, through the shared primitive —
+        # attempts land in dl4jtpu_retry_attempts_total{component="ui_remote"}
+        self._policy = RetryPolicy(max_attempts=retries, base_delay=0.02,
+                                   max_delay=0.5, classify=lambda e: True)
         self.dropped = 0
         self._q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._pending = 0                    # enqueued but not yet settled
@@ -75,14 +81,13 @@ class WebReporter:
             except queue.Empty:
                 continue
             ok = False
-            for _ in range(self.retries):
-                try:
-                    getattr(self._router, method)(*args)
-                    ok = True
-                    break
-                except Exception:
-                    if self._closed.is_set():
-                        break
+            try:
+                retry_call(getattr(self._router, method), *args,
+                           policy=self._policy, component="ui_remote",
+                           give_up=self._closed.is_set)
+                ok = True
+            except Exception:   # noqa: BLE001 — exhausted/aborted: drop
+                pass
             with self._lock:
                 self._pending -= 1
                 if not ok:

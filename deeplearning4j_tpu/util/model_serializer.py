@@ -7,17 +7,29 @@ optional normalizer. Here: configuration.json (our JSON DSL) +
 ``updaterState.npz`` + ``modelState.npz`` (batchnorm running stats etc.) +
 ``normalizer.json``. Updater-state round-tripping is part of the contract
 (reference ModelSerializerTest) — training resumes bit-exact.
+
+Durability contract (docs/FAULT_TOLERANCE.md): ``write_model`` to a path is
+ATOMIC — the zip is staged to a temp file in the target directory, fsynced,
+then ``os.replace``d over the destination, so a reader (or a process killed
+mid-save) only ever sees the old complete checkpoint or the new complete
+checkpoint, never a torn one. A checkpoint that IS damaged (truncated copy,
+bad disk) surfaces as one clear ``CorruptCheckpointError`` naming the
+missing/unreadable member instead of a bare ``KeyError``/``BadZipFile``.
 """
 
 from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
+import zlib
 from typing import Any
 
 import numpy as np
 import jax
+
+from deeplearning4j_tpu.resilience.errors import CorruptCheckpointError
 
 CONFIG_NAME = "configuration.json"
 COEFF_NAME = "coefficients.npz"
@@ -25,6 +37,13 @@ UPDATER_NAME = "updaterState.npz"
 STATE_NAME = "modelState.npz"
 NORMALIZER_NAME = "normalizer.json"
 META_NAME = "meta.json"
+
+__all__ = [
+    "CorruptCheckpointError", "write_model", "restore_multi_layer_network",
+    "restore_computation_graph", "restore_into", "restore_normalizer",
+    "read_meta", "guess_model", "META_NAME", "CONFIG_NAME", "COEFF_NAME",
+    "UPDATER_NAME", "STATE_NAME", "NORMALIZER_NAME",
+]
 
 
 def _flatten_pytree(tree) -> dict:
@@ -65,21 +84,82 @@ def _savez(z: zipfile.ZipFile, name: str, arrays: dict):
     z.writestr(name, buf.getvalue())
 
 
-def _loadz(z: zipfile.ZipFile, name: str) -> dict:
-    with z.open(name) as f:
-        data = np.load(io.BytesIO(f.read()), allow_pickle=False)
+def _open_zip(path) -> zipfile.ZipFile:
+    """Open a checkpoint zip, mapping a damaged archive to
+    CorruptCheckpointError (FileNotFoundError passes through untouched)."""
+    try:
+        return zipfile.ZipFile(path, "r")
+    except zipfile.BadZipFile as e:
+        raise CorruptCheckpointError(path, detail=str(e)) from e
+
+
+def _read_member(z: zipfile.ZipFile, path, name: str) -> bytes:
+    """Read one member, naming it in the error if missing or unreadable
+    (truncated central directory, CRC mismatch, bad deflate stream)."""
+    try:
+        return z.read(name)
+    except KeyError as e:
+        raise CorruptCheckpointError(path, member=name,
+                                     detail="member missing") from e
+    except (zipfile.BadZipFile, zlib.error, EOFError, OSError) as e:
+        raise CorruptCheckpointError(path, member=name, detail=str(e)) from e
+
+
+def _loadz(z: zipfile.ZipFile, path, name: str) -> dict:
+    raw = _read_member(z, path, name)
+    try:
+        data = np.load(io.BytesIO(raw), allow_pickle=False)
         return {k: data[k] for k in data.files}
+    except (zipfile.BadZipFile, ValueError, zlib.error, EOFError, OSError) as e:
+        raise CorruptCheckpointError(path, member=name, detail=str(e)) from e
 
 
 def write_model(model, path, save_updater=True, normalizer=None):
-    """Parity: ModelSerializer.writeModel :52."""
+    """Parity: ModelSerializer.writeModel :52.
+
+    Filesystem paths are written ATOMICALLY: the zip is staged to a unique
+    temp file in the destination directory, fsynced, then ``os.replace``d
+    into place — a crash mid-save leaves the previous checkpoint intact and
+    never exposes a torn zip. File-like targets (e.g. the BytesIO held by
+    InMemoryModelSaver) are written directly.
+    """
+    if hasattr(path, "write"):
+        _write_model_to(model, path, save_updater, normalizer)
+        return
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as fh:
+            _write_model_to(model, fh, save_updater, normalizer)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:        # make the rename itself durable; best-effort on odd FSes
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def _write_model_to(model, fileobj, save_updater, normalizer):
     from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
     kind = "MultiLayerNetwork" if isinstance(model, MultiLayerNetwork) \
         else "ComputationGraph"
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+    with zipfile.ZipFile(fileobj, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(META_NAME, json.dumps({
             "format": "deeplearning4j_tpu/model/v1", "kind": kind,
-            "iteration": model.iteration, "epoch": model.epoch}))
+            "iteration": model.iteration, "epoch": model.epoch,
+            "epoch_batch": int(getattr(model, "_epoch_batch", 0))}))
         z.writestr(CONFIG_NAME, model.conf.to_json())
         _savez(z, COEFF_NAME, _flatten_pytree(model.params))
         _savez(z, STATE_NAME, _flatten_pytree(model.state))
@@ -89,15 +169,23 @@ def write_model(model, path, save_updater=True, normalizer=None):
             z.writestr(NORMALIZER_NAME, json.dumps(normalizer.to_dict()))
 
 
+def _load_meta(z: zipfile.ZipFile, path) -> dict:
+    try:
+        return json.loads(_read_member(z, path, META_NAME))
+    except json.JSONDecodeError as e:
+        raise CorruptCheckpointError(path, member=META_NAME,
+                                     detail=str(e)) from e
+
+
 def _restore(path, load_updater, kind_expected):
     from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
     from deeplearning4j_tpu.models.computation_graph import ComputationGraph
     from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
 
-    with zipfile.ZipFile(path, "r") as z:
-        meta = json.loads(z.read(META_NAME))
-        conf_json = z.read(CONFIG_NAME).decode()
+    with _open_zip(path) as z:
+        meta = _load_meta(z, path)
+        conf_json = _read_member(z, path, CONFIG_NAME).decode()
         if meta["kind"] == "MultiLayerNetwork":
             conf = MultiLayerConfiguration.from_json(conf_json)
             model = MultiLayerNetwork(conf)
@@ -107,14 +195,19 @@ def _restore(path, load_updater, kind_expected):
         if kind_expected and meta["kind"] != kind_expected:
             raise ValueError(f"Expected {kind_expected}, zip holds {meta['kind']}")
         model.init()
-        model.params = _unflatten_into(model.params, _loadz(z, COEFF_NAME))
-        model.state = _unflatten_into(model.state, _loadz(z, STATE_NAME))
-        if load_updater and UPDATER_NAME in z.namelist():
-            model.opt_state = _unflatten_into(model.opt_state,
-                                              _loadz(z, UPDATER_NAME))
-        model.iteration = meta.get("iteration", 0)
-        model.epoch = meta.get("epoch", 0)
+        _load_state_into(model, z, path, meta, load_updater)
         return model
+
+
+def _load_state_into(model, z, path, meta, load_updater):
+    model.params = _unflatten_into(model.params, _loadz(z, path, COEFF_NAME))
+    model.state = _unflatten_into(model.state, _loadz(z, path, STATE_NAME))
+    if load_updater and UPDATER_NAME in z.namelist():
+        model.opt_state = _unflatten_into(model.opt_state,
+                                          _loadz(z, path, UPDATER_NAME))
+    model.iteration = meta.get("iteration", 0)
+    model.epoch = meta.get("epoch", 0)
+    model._epoch_batch = meta.get("epoch_batch", 0)
 
 
 def restore_multi_layer_network(path, load_updater=True):
@@ -126,12 +219,36 @@ def restore_computation_graph(path, load_updater=True):
     return _restore(path, load_updater, "ComputationGraph")
 
 
+def restore_into(model, path, load_updater=True):
+    """Load a checkpoint's tensors + counters into an EXISTING initialized
+    model in place (the container's ``resume_from=`` path — keeps the
+    caller's listeners, prefetch config and compiled-step caches). The
+    checkpoint kind must match the model's class. Returns ``model``."""
+    kind = type(model).__name__
+    with _open_zip(path) as z:
+        meta = _load_meta(z, path)
+        if meta["kind"] != kind:
+            raise ValueError(f"Expected {kind}, zip holds {meta['kind']}")
+        if model.params is None:
+            model.init()
+        _load_state_into(model, z, path, meta, load_updater)
+    return model
+
+
+def read_meta(path) -> dict:
+    """Checkpoint metadata (kind/iteration/epoch/epoch_batch) without
+    loading any tensors — what CheckpointManager's manifest records."""
+    with _open_zip(path) as z:
+        return _load_meta(z, path)
+
+
 def restore_normalizer(path):
     from deeplearning4j_tpu.data.normalizers import Normalizer
-    with zipfile.ZipFile(path, "r") as z:
+    with _open_zip(path) as z:
         if NORMALIZER_NAME not in z.namelist():
             return None
-        return Normalizer.from_dict(json.loads(z.read(NORMALIZER_NAME)))
+        return Normalizer.from_dict(
+            json.loads(_read_member(z, path, NORMALIZER_NAME)))
 
 
 def guess_model(path):
@@ -147,7 +264,7 @@ def guess_model(path):
         with open(path, "rb") as fh:
             magic = fh.read(8)
     if magic[:4] == b"PK\x03\x04":          # our zip checkpoint
-        with zipfile.ZipFile(path, "r") as z:
+        with _open_zip(path) as z:
             if META_NAME not in z.namelist():
                 raise ValueError(
                     f"{path} is a zip but not a deeplearning4j_tpu "

@@ -1,0 +1,81 @@
+"""Training victim for the SIGKILL soak test (not a pytest module).
+
+Builds the same deterministic net + data as tests/test_resilience.py,
+trains with a CheckpointListener, and lets the parent SIGKILL it mid-run
+— a real process death, not an in-process exception. Progress is visible
+to the parent through the checkpoint directory itself (every zip is
+written atomically, so whatever the kill leaves behind must be loadable).
+
+Usage: _crash_worker.py <ckpt_dir> <epochs> <step_delay_ms>
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_xla = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla:
+    os.environ["XLA_FLAGS"] = (
+        _xla + " --xla_force_host_platform_device_count=8").strip()
+
+
+def build_net(chunk_steps=4):
+    """Tiny deterministic MLP; small chunk cap so the iteration counter
+    advances in several fit_scan jumps per epoch."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net._CHUNK_MAX_STEPS = chunk_steps
+    return net
+
+
+def build_data():
+    """48 examples, batch 8 → 6 iterations/epoch; shuffle=True so resume
+    must also reproduce the iterator's RNG position, not just the params."""
+    import numpy as np
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    rs = np.random.RandomState(7)
+    x = rs.rand(48, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 48)]
+    return ListDataSetIterator(DataSet(x, y), 8, shuffle=True)
+
+
+class _Throttle:
+    """Slow each iteration so the parent's SIGKILL lands mid-run."""
+
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+
+    def iteration_done(self, model, iteration, epoch):
+        time.sleep(self.delay_s)
+
+    def on_epoch_end(self, model):
+        pass
+
+
+def main():
+    ckpt_dir, epochs, delay_ms = (sys.argv[1], int(sys.argv[2]),
+                                  float(sys.argv[3]))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deeplearning4j_tpu.resilience.checkpoint import CheckpointListener
+    net = build_net()
+    net.listeners.append(_Throttle(delay_ms / 1000.0))
+    listener = CheckpointListener(ckpt_dir, every_n_iterations=2,
+                                  keep_last=3)
+    net.fit(build_data(), epochs=epochs, checkpoint=listener)
+    print(f"WORKER_DONE iteration={net.iteration}")
+
+
+if __name__ == "__main__":
+    main()

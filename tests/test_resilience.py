@@ -1,0 +1,651 @@
+"""Fault tolerance end-to-end (docs/FAULT_TOLERANCE.md).
+
+The load-bearing claims pinned here:
+
+- ``write_model`` is ATOMIC: a crash before the final rename leaves the
+  previous checkpoint intact and no torn zip or temp litter behind;
+- a truncated/damaged checkpoint surfaces as one ``CorruptCheckpointError``
+  naming the unreadable member, not a bare ``KeyError``/``BadZipFile``;
+- ``CheckpointManager`` keeps the newest ``keep_last`` unpinned saves plus
+  every ``keep_every``-th pinned one, and rebuilds its ledger from the
+  directory when the manifest is damaged out-of-band;
+- a run killed mid-epoch and resumed via ``fit(resume_from=...)`` is
+  BITWISE-identical (params, updater state, counters) to the uninterrupted
+  run — in-process with ``SimulatedCrash`` (fast, tier-1) and with a real
+  SIGKILL over a process boundary (slow soak);
+- the shared retry primitive backs off with bounded decorrelated jitter,
+  respects deadlines (never sleeps past the budget), honours ``give_up``,
+  raises fatal errors immediately, and lands every attempt in
+  ``dl4jtpu_retry_attempts_total`` on GET /metrics;
+- the serving stack under overload: queue-full requests shed FAST with
+  HTTP 429, expired deadlines are answered without ever riding a device
+  call (504), drain flips /healthz to 503 draining, and ``stop()`` settles
+  every Future — including submits racing the stop.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import zipfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from _crash_worker import build_data, build_net
+
+from deeplearning4j_tpu.clustering.knn_server import (
+    ndarray_from_b64, ndarray_to_b64)
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.resilience import (
+    BatcherStoppedError, Checkpoint, CheckpointListener, CheckpointManager,
+    CorruptCheckpointError, DeadlineExceededError, FatalError, RetryPolicy,
+    RetriesExhaustedError, ServerOverloadedError, StreamStalledError,
+    TransientError, default_classifier, latest_checkpoint, retry_call)
+from deeplearning4j_tpu.resilience.faults import (
+    CrashAfter, FlakyBroker, FlakyEngine, SimulatedCrash)
+from deeplearning4j_tpu.serving import InferenceServer, MicroBatcher
+from deeplearning4j_tpu.util.model_serializer import (
+    read_meta, restore_into, write_model)
+
+_WORKER = Path(__file__).with_name("_crash_worker.py")
+
+
+def _leaves(net):
+    import jax
+    return [np.asarray(l) for l in
+            jax.tree_util.tree_leaves((net.params, net.state, net.opt_state))]
+
+
+def _assert_bitwise_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert np.array_equal(x, y), f"leaf {i} diverged"
+
+
+def _wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.002)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------------- atomic writes
+
+def test_write_model_crash_before_rename_keeps_old_checkpoint(
+        tmp_path, monkeypatch):
+    net = build_net()
+    target = tmp_path / "model.zip"
+    write_model(net, str(target))
+    original = target.read_bytes()
+
+    def boom(src, dst):
+        raise OSError("simulated crash before rename")
+
+    monkeypatch.setattr(os, "replace", boom)
+    net.fit(np.ones((3, 4), np.float32), np.eye(3, dtype=np.float32))
+    with pytest.raises(OSError, match="simulated crash"):
+        write_model(net, str(target))
+    # old checkpoint intact, no temp litter, and still loadable
+    assert target.read_bytes() == original
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["model.zip"]
+    monkeypatch.undo()
+    assert read_meta(str(target))["kind"] == "MultiLayerNetwork"
+
+
+def test_write_model_crash_on_fresh_path_leaves_nothing(tmp_path, monkeypatch):
+    def boom(src, dst):
+        raise OSError("simulated crash before rename")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        write_model(build_net(), str(tmp_path / "fresh.zip"))
+    assert list(tmp_path.iterdir()) == []
+
+
+# -------------------------------------------------------- corrupt checkpoints
+
+def test_truncated_checkpoint_raises_corrupt_error(tmp_path):
+    p = tmp_path / "m.zip"
+    write_model(build_net(), str(p))
+    data = p.read_bytes()
+    p.write_bytes(data[:len(data) // 2])
+    with pytest.raises(CorruptCheckpointError):
+        restore_into(build_net(), str(p))
+    assert issubclass(CorruptCheckpointError, ValueError)
+
+
+def test_missing_member_named_in_corrupt_error(tmp_path):
+    p = tmp_path / "m.zip"
+    write_model(build_net(), str(p))
+    with zipfile.ZipFile(p) as z:
+        members = {n: z.read(n) for n in z.namelist()}
+    gutted = tmp_path / "gutted.zip"
+    with zipfile.ZipFile(gutted, "w") as z:
+        for name, blob in members.items():
+            if name != "coefficients.npz":
+                z.writestr(name, blob)
+    with pytest.raises(CorruptCheckpointError, match="coefficients"):
+        restore_into(build_net(), str(gutted))
+
+
+# ----------------------------------------------------- manager: keep policies
+
+def test_keep_last_rotation_and_keep_every_pinning(tmp_path):
+    net = build_net()
+    mgr = CheckpointManager(tmp_path, keep_last=2, keep_every=3)
+    for i in range(1, 8):                       # 7 saves at iterations 1..7
+        net.iteration = i
+        mgr.save(net)
+    # pinned: saves #1, #4, #7; unpinned survivors: the newest 2 (5, 6)
+    live = sorted(c.iteration for c in mgr.checkpoints())
+    assert live == [1, 4, 5, 6, 7]
+    assert sorted(c.iteration for c in mgr.checkpoints() if c.pinned) \
+        == [1, 4, 7]
+    on_disk = sorted(p.name for p in tmp_path.glob("checkpoint_*.zip"))
+    assert len(on_disk) == 5
+    assert latest_checkpoint(tmp_path).endswith(
+        "checkpoint_iter0000000007_epoch0000.zip")
+
+
+def test_manager_recovers_from_damaged_manifest(tmp_path):
+    net = build_net()
+    mgr = CheckpointManager(tmp_path, keep_last=5)
+    for i in (3, 9):
+        net.iteration = i
+        mgr.save(net)
+    (tmp_path / "manifest.json").write_text("{torn garbage")
+    recovered = CheckpointManager(tmp_path, keep_last=5)
+    assert sorted(c.iteration for c in recovered.checkpoints()) == [3, 9]
+    # a zip deleted out-of-band drops out of the ledger instead of 404ing
+    os.unlink(latest_checkpoint(tmp_path))
+    again = CheckpointManager(tmp_path, keep_last=5)
+    assert [c.iteration for c in again.checkpoints()] == [3]
+    assert latest_checkpoint(tmp_path).endswith("iter0000000003_epoch0000.zip")
+
+
+def test_checkpoint_listener_requires_a_cadence(tmp_path):
+    with pytest.raises(ValueError):
+        CheckpointListener(tmp_path)
+
+
+# -------------------------------------------------------- kill-and-resume fit
+
+def test_fit_checkpoint_directory_saves_every_epoch(tmp_path):
+    net = build_net(chunk_steps=64)
+    net.fit(build_data(), epochs=2, checkpoint=str(tmp_path))
+    names = sorted(p.name for p in tmp_path.glob("checkpoint_*.zip"))
+    assert names == ["checkpoint_iter0000000006_epoch0001.zip",
+                     "checkpoint_iter0000000012_epoch0002.zip"]
+    assert read_meta(latest_checkpoint(tmp_path))["iteration"] == 12
+
+
+def test_resume_guards():
+    net = build_net()
+    with pytest.raises(ValueError, match="resettable"):
+        net.fit(np.ones((4, 4), np.float32),
+                np.eye(3, dtype=np.float32)[[0, 1, 2, 0]],
+                resume_from="/nonexistent")
+
+
+def test_resume_from_empty_directory_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        build_net().fit(build_data(), epochs=1, resume_from=str(tmp_path))
+
+
+def test_crash_mid_epoch_resume_is_bitwise_identical(tmp_path):
+    """The tier-1 kill-and-resume: crash inside epoch 2 (iteration 12 of
+    18), resume from the iteration-10 checkpoint — the resumed run must
+    replay epoch 1 through the shuffling iterator, skip the 4 already
+    trained batches of epoch 2, and finish bitwise-equal to the
+    uninterrupted run (params AND Adam state AND counters)."""
+    ref = build_net()
+    ref.fit(build_data(), epochs=3)
+    assert ref.iteration == 18 and ref.epoch == 3
+
+    ckpt_dir = tmp_path / "ckpts"
+    victim = build_net()
+    crash = CrashAfter(at_iteration=11)
+    victim.listeners.append(crash)          # fires BEFORE the ckpt listener
+    listener = CheckpointListener(str(ckpt_dir), every_n_iterations=2)
+    with pytest.raises(SimulatedCrash):
+        victim.fit(build_data(), epochs=3, checkpoint=listener)
+    assert crash.fired
+    # chunked fit (4+2 steps/epoch): the delta trigger fires at the first
+    # chunk boundary ≥ 2 past its anchor — iterations 6 and 10, not 12
+    # (the crash beats the listener to iteration 12)
+    assert sorted(c.iteration for c in listener.manager.checkpoints()) \
+        == [6, 10]
+    meta = read_meta(latest_checkpoint(ckpt_dir))
+    assert (meta["iteration"], meta["epoch"], meta["epoch_batch"]) \
+        == (10, 1, 4)
+
+    resumed = build_net()
+    resumed.fit(build_data(), epochs=3, resume_from=str(ckpt_dir))
+    assert resumed.iteration == ref.iteration and resumed.epoch == ref.epoch
+    _assert_bitwise_equal(ref, resumed)
+
+
+@pytest.mark.slow
+def test_sigkill_soak_resume_is_bitwise_identical(tmp_path):
+    """The real thing: a subprocess training with checkpoints is SIGKILLed
+    mid-run; whatever the kill left in the checkpoint directory must be
+    loadable and resume to the uninterrupted result bitwise."""
+    ckpt_dir = tmp_path / "ckpts"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(_WORKER.parents[1])
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, str(_WORKER), str(ckpt_dir), "3", "40"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True)
+    try:
+        # kill only after real progress: two checkpoints on disk
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            zips = (sorted(ckpt_dir.glob("checkpoint_*.zip"))
+                    if ckpt_dir.is_dir() else [])
+            if len(zips) >= 2:
+                break
+            if proc.poll() is not None:
+                pytest.fail("worker exited before the kill:\n"
+                            + proc.stdout.read())
+            time.sleep(0.02)
+        else:
+            pytest.fail("worker made no checkpoint progress in 240s")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+        assert proc.returncode != 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    found = latest_checkpoint(ckpt_dir)
+    assert found is not None
+    meta = read_meta(found)
+    assert 0 < meta["iteration"] < 18       # genuinely killed mid-run
+
+    ref = build_net()
+    ref.fit(build_data(), epochs=3)
+    resumed = build_net()
+    resumed.fit(build_data(), epochs=3, resume_from=str(ckpt_dir))
+    assert resumed.iteration == ref.iteration == 18
+    _assert_bitwise_equal(ref, resumed)
+
+
+# -------------------------------------------------------------- retry/backoff
+
+class _FakeTime:
+    """Injectable clock+sleeper: no real sleeping in tier-1."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def test_retry_succeeds_after_transient_failures():
+    import random
+    ft = _FakeTime()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("blip")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.05, max_delay=2.0)
+    assert retry_call(flaky, policy=policy, component="test_ok",
+                      sleep=ft.sleep, clock=ft.clock,
+                      rng=random.Random(0)) == "ok"
+    assert calls["n"] == 3
+    # two backoffs, decorrelated-jitter bounded: [base, prev*3] ∩ [0, max]
+    assert len(ft.sleeps) == 2
+    assert 0.05 <= ft.sleeps[0] <= 0.15
+    assert 0.05 <= ft.sleeps[1] <= min(2.0, ft.sleeps[0] * 3)
+
+
+def test_retry_exhausts_attempts():
+    import random
+    ft = _FakeTime()
+
+    def always():
+        raise TransientError("down")
+
+    with pytest.raises(RetriesExhaustedError) as ei:
+        retry_call(always, policy=RetryPolicy(max_attempts=4, base_delay=0.1,
+                                              max_delay=0.5),
+                   component="test_exhaust", sleep=ft.sleep, clock=ft.clock,
+                   rng=random.Random(1))
+    assert ei.value.attempts == 4
+    assert len(ft.sleeps) == 3
+    assert all(0.1 <= s <= 0.5 for s in ft.sleeps)
+    assert isinstance(ei.value.__cause__, TransientError)
+
+
+def test_retry_deadline_never_sleeps_past_budget():
+    import random
+    ft = _FakeTime()
+
+    def slow_fail():
+        ft.t += 0.2                         # each attempt costs 200ms
+        raise TransientError("down")
+
+    policy = RetryPolicy(max_attempts=None, base_delay=0.4, max_delay=10.0,
+                         deadline=1.0)
+    with pytest.raises(RetriesExhaustedError, match="deadline"):
+        retry_call(slow_fail, policy=policy, component="test_deadline",
+                   sleep=ft.sleep, clock=ft.clock, rng=random.Random(2))
+    # total fake time ≤ deadline + one attempt's cost: the backoff was
+    # capped to the remaining budget instead of sleeping through it
+    assert ft.t <= 1.0 + 0.2 + 1e-6
+
+
+def test_retry_give_up_aborts_promptly():
+    ft = _FakeTime()
+    flag = {"stop": False}
+
+    def failing():
+        flag["stop"] = True                 # shutdown begins mid-call
+        raise TransientError("down")
+
+    with pytest.raises(RetriesExhaustedError, match="give_up"):
+        retry_call(failing, policy=RetryPolicy(max_attempts=None),
+                   component="test_giveup", give_up=lambda: flag["stop"],
+                   sleep=ft.sleep, clock=ft.clock)
+    assert ft.sleeps == []                  # no backoff after the abort flag
+
+
+def test_retry_fatal_raises_immediately():
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("deterministic bug")
+
+    with pytest.raises(ValueError):
+        retry_call(fatal, component="test_fatal",
+                   sleep=lambda s: pytest.fail("slept on a fatal error"))
+    assert calls["n"] == 1
+
+
+def test_default_classifier():
+    retryable = [TransientError("x"), ServerOverloadedError("x"),
+                 ConnectionError("x"), TimeoutError("x"), BrokenPipeError(),
+                 urllib.error.URLError("refused"),
+                 urllib.error.HTTPError("http://x", 429, "too many", {},
+                                        None),
+                 urllib.error.HTTPError("http://x", 503, "unavail", {},
+                                        None)]
+    fatal = [FatalError("x"), DeadlineExceededError("x"), ValueError("x"),
+             KeyError("x"), FileNotFoundError("x"),
+             urllib.error.HTTPError("http://x", 404, "nope", {}, None),
+             urllib.error.HTTPError("http://x", 400, "bad", {}, None)]
+    assert all(default_classifier(e) for e in retryable)
+    assert not any(default_classifier(e) for e in fatal)
+
+
+def test_retry_metrics_visible_on_metrics_endpoint():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise TransientError("blip")
+        return 1
+
+    retry_call(flaky, policy=RetryPolicy(base_delay=0.0, max_delay=0.0),
+               component="metrics_probe", sleep=lambda s: None)
+    srv = InferenceServer(build_net(), port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            body = r.read().decode()
+    finally:
+        srv.stop()
+    assert "dl4jtpu_retry_attempts_total" in body
+    assert 'component="metrics_probe"' in body
+    assert 'outcome="error"' in body and 'outcome="success"' in body
+
+
+# ------------------------------------------------------------ streaming/kafka
+
+def test_streaming_iterator_detects_stalled_producer():
+    from deeplearning4j_tpu.data.streaming import StreamingDataSetIterator
+    it = StreamingDataSetIterator(2, stall_timeout=0.15)
+    it.push(np.zeros(4, np.float32), np.zeros(3, np.float32))
+    with pytest.raises(StreamStalledError):
+        next(iter(it))
+
+
+def test_kafka_pump_retries_polls_and_skips_corrupt_records():
+    from deeplearning4j_tpu.data.kafka import (
+        InMemoryBroker, NDArrayPublisher, NDArrayPubSubRoute)
+    base = InMemoryBroker()
+    topic = "resilience_topic"
+    # first poll fails with a transient connection reset → pump retries
+    broker = FlakyBroker(base, fail_polls={0: ConnectionError("reset")})
+    pub = NDArrayPublisher(broker, topic)
+    for i in range(4):
+        pub.publish(np.full(4, float(i), np.float32),
+                    np.eye(3, dtype=np.float32)[i % 3])
+    base.send(topic, b"!!not a record!!")    # poison message
+    route = NDArrayPubSubRoute(broker, topic, batch_size=2)
+    route.start()
+    try:
+        it = iter(route.iterator)
+        ds1, ds2 = next(it), next(it)
+    finally:
+        route.stop()
+    assert broker.poll_calls >= 2            # the failed poll was retried
+    got = np.concatenate([ds1.features, ds2.features])[:, 0].tolist()
+    assert got == [0.0, 1.0, 2.0, 3.0]       # order preserved, none lost
+    corrupt = get_registry().counter(
+        "dl4jtpu_stream_corrupt_records_total",
+        "Undecodable records skipped by streaming consumers.",
+        ("topic",)).labels(topic=topic)
+    assert corrupt.value >= 1
+
+
+# ----------------------------------------------------------- serving overload
+
+def _post_raw(url, payload, timeout=60):
+    data = (payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode())
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode()
+        try:
+            return e.code, json.loads(raw)
+        except json.JSONDecodeError:
+            return e.code, {"raw": raw}
+
+
+def _get_raw(url, timeout=60):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _predict_payload(n_rows, deadline_ms=None):
+    payload = {"ndarray": ndarray_to_b64(np.ones((n_rows, 4), np.float32))}
+    if deadline_ms is not None:
+        payload["deadline_ms"] = deadline_ms
+    return payload
+
+
+def test_http_storm_429_deadline_and_drain():
+    net = build_net()
+    base = net.serving_engine()
+    base.warmup((4,), max_batch=8)
+    gate = threading.Event()                 # holds the "device" busy
+    eng = FlakyEngine(base, gate=gate)
+    srv = InferenceServer(net, port=0, engine=eng, max_queue=2,
+                          max_latency_ms=1.0).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    results = {}
+
+    def post(name, n_rows, deadline_ms=None):
+        results[name] = _post_raw(url + "/predict",
+                                  _predict_payload(n_rows, deadline_ms))
+
+    threads = []
+    try:
+        t = threading.Thread(target=post, args=("r1", 2))
+        t.start()
+        threads.append(t)
+        _wait_for(lambda: eng.calls >= 1, what="r1 riding the gated call")
+        for name, rows, dl in (("r2", 3, 80.0), ("r3", 1, None)):
+            t = threading.Thread(target=post, args=(name, rows, dl))
+            t.start()
+            threads.append(t)
+        _wait_for(lambda: srv.batcher.stats()["queue_depth"] == 2,
+                  what="queue to fill")
+        # queue full: shed FAST with 429 — the handler never blocks
+        t0 = time.perf_counter()
+        code, body = _post_raw(url + "/predict", _predict_payload(1))
+        assert (code, body["error"]["type"]) == (429, "overloaded")
+        assert time.perf_counter() - t0 < 2.0
+        code, body = _get_raw(url + "/healthz")
+        assert (code, body["status"]) == (200, "degraded")
+        time.sleep(0.12)                     # r2's 80ms deadline expires
+        gate.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert results["r1"][0] == 200
+        assert results["r3"][0] == 200
+        assert ndarray_from_b64(results["r3"][1]["ndarray"]).shape == (1, 3)
+        assert results["r2"][0] == 504
+        assert results["r2"][1]["error"]["type"] == "deadline_exceeded"
+        # the expired request never rode a device call: the engine saw
+        # exactly r1's 2 rows + r3's 1 row, never r2's 3
+        assert eng.rows_seen == 3
+        rej = srv.batcher.stats()["rejected"]
+        assert rej["queue_full"] >= 1 and rej["deadline"] >= 1
+        # drain: healthz flips to 503 draining, predicts get fast 503s
+        srv.batcher.stop()
+        code, body = _get_raw(url + "/healthz")
+        assert (code, body["status"]) == (503, "draining")
+        code, body = _post_raw(url + "/predict", _predict_payload(1))
+        assert (code, body["error"]["type"]) == (503, "draining")
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_http_bad_request_vs_engine_fault_classification():
+    net = build_net()
+    eng = FlakyEngine(net.serving_engine(),
+                      fail_calls={0: RuntimeError("injected device fault")})
+    srv = InferenceServer(net, port=0, engine=eng,
+                          max_latency_ms=1.0).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        # --- 400s: client problems, never 500 ---
+        code, body = _post_raw(url + "/predict", {})
+        assert (code, body["error"]["type"]) == (400, "bad_request")
+        assert "ndarray" in body["error"]["message"]
+        code, body = _post_raw(url + "/predict",
+                               {"ndarray": {"shape": [2], "data": "!"}})
+        assert (code, body["error"]["type"]) == (400, "bad_request")
+        code, body = _post_raw(url + "/predict", b"{not json")
+        assert (code, body["error"]["type"]) == (400, "bad_request")
+        wrong_width = {"ndarray": ndarray_to_b64(
+            np.ones((2, 5), np.float32))}   # model wants 4 features
+        code, body = _post_raw(url + "/predict", wrong_width)
+        assert (code, body["error"]["type"]) == (400, "bad_request")
+        assert "(2, 5)" in body["error"]["message"]
+        payload = _predict_payload(1)
+        payload["deadline_ms"] = "soon"
+        code, body = _post_raw(url + "/predict", payload)
+        assert (code, body["error"]["type"]) == (400, "bad_request")
+        code, body = _post_raw(url + "/nope", {})
+        assert (code, body["error"]["type"]) == (404, "not_found")
+        assert eng.calls == 0               # none of the above hit the engine
+        # --- 500: a genuine engine fault, reported then recovered ---
+        code, body = _post_raw(url + "/predict", _predict_payload(2))
+        assert (code, body["error"]["type"]) == (500, "internal")
+        assert "injected device fault" in body["error"]["message"]
+        assert "injected device fault" in srv.last_error
+        code, body = _post_raw(url + "/predict", _predict_payload(2))
+        assert code == 200                  # fault was one-shot; recovered
+        assert _get_raw(url + "/healthz")[1]["status"] == "ok"
+    finally:
+        srv.stop()
+
+
+def test_batcher_stop_race_settles_every_future():
+    net = build_net()
+    base = net.serving_engine()
+    base.warmup((4,), max_batch=16)
+    gate = threading.Event()
+    eng = FlakyEngine(base, gate=gate)
+    mb = MicroBatcher(eng, max_batch=16, max_latency_ms=1.0).start()
+    x = np.zeros((1, 4), np.float32)
+    futs = [mb.submit(x) for _ in range(6)]  # first rides, the rest queue
+    racing = []
+
+    def spam():
+        for _ in range(200):
+            try:
+                racing.append(mb.submit(x))
+            except BatcherStoppedError:
+                return
+
+    spammer = threading.Thread(target=spam)
+    stopper = threading.Thread(target=mb.stop)
+    spammer.start()
+    stopper.start()
+    time.sleep(0.05)
+    gate.set()
+    stopper.join(timeout=60)
+    spammer.join(timeout=60)
+    assert not stopper.is_alive() and not spammer.is_alive()
+    # every Future settled: flushed with a result, or rejected — never hung
+    for f in futs + racing:
+        assert f.done()
+        exc = f.exception()
+        assert exc is None or isinstance(exc, BatcherStoppedError)
+    assert all(f.exception() is None for f in futs)  # pre-stop work flushed
+    with pytest.raises(BatcherStoppedError):
+        mb.submit(x)
+
+
+# -------------------------------------------------------------- earlystopping
+
+def test_early_stopping_trainer_accepts_tuple_iterator():
+    from deeplearning4j_tpu.earlystopping.early_stopping import (
+        EarlyStoppingConfiguration, EarlyStoppingTrainer,
+        MaxEpochsTerminationCondition)
+    net = build_net()
+    rs = np.random.RandomState(3)
+    data = [(rs.rand(8, 4).astype(np.float32),
+             np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)])
+            for _ in range(2)]
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)])
+    result = EarlyStoppingTrainer(cfg, net, data).fit()
+    assert result.total_epochs == 2
+    assert net.iteration == 4               # 2 epochs × 2 tuple batches
+    assert result.best_model is net

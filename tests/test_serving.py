@@ -203,12 +203,18 @@ def test_micro_batcher_coalesces_and_demuxes():
         mb.stop()
 
 
-def test_micro_batcher_stop_fails_pending_futures():
+def test_micro_batcher_stop_rejects_new_submits():
+    from deeplearning4j_tpu.resilience.errors import BatcherStoppedError
     net = _mlp()
     mb = MicroBatcher(net.serving_engine(), max_latency_ms=1.0)
     mb.start()
     mb.stop()
-    # queue drained; a fresh submit after stop restarts the worker
+    # stopped is terminal for submit(): fail fast instead of hanging a
+    # Future forever (the old restart-on-submit behavior raced the drain)
+    with pytest.raises(BatcherStoppedError):
+        mb.submit(np.zeros((2, 4), np.float32))
+    # an explicit start() is still allowed to bring it back
+    mb.start()
     fut = mb.submit(np.zeros((2, 4), np.float32))
     assert fut.result(timeout=30).shape == (2, 3)
     mb.stop()
@@ -233,8 +239,9 @@ def test_http_server_roundtrip_warmup_and_stats():
         stats = cli.stats()
         assert stats["engine"]["compiled_programs"] >= 4
         assert stats["batcher"]["requests"] >= 2
-        # malformed payload comes back as an error reply, not a hung socket
-        with pytest.raises(RuntimeError, match="reshape|bad json|decode"):
+        # malformed payload comes back as a structured 400, not a hung
+        # socket (and not a 500 — see test_resilience for the full matrix)
+        with pytest.raises(ValueError, match="undecodable|reshape|decode"):
             cli._request("/predict", {"ndarray": {"shape": [2], "data": "!"}})
     finally:
         srv.stop()
