@@ -786,6 +786,53 @@ class ComputationGraph:
         """Parity: ComputationGraph.rnnClearPreviousState."""
         self._rnn_carries = None
 
+    # --------------------------------------------------- incremental decode
+    def init_decode_state(self, batch: int, max_len: int = 256):
+        """Decode state keyed by layer-node name (see
+        MultiLayerNetwork.init_decode_state; serving/decode.py holds this
+        tree resident on device across token steps)."""
+        gc = self.conf.global_conf
+        dt = _dtype_of(gc.compute_dtype or gc.dtype)
+        out = {}
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if node.kind == "layer":
+                out[name] = node.layer.init_decode_state(
+                    self.params.get(name, {}), batch, max_len, dt)
+        return out
+
+    def decode_step(self, params, state, dstate, x_t, pos):
+        """Pure one-token step along the topo order (single-input,
+        single-path graphs; vertices like residual adds work on the
+        (B, 1, F) slices unchanged). Bitwise contract and compute-dtype
+        handling match MultiLayerNetwork.decode_step."""
+        if len(self.conf.network_inputs) != 1:
+            raise ValueError(
+                "incremental decode supports single-input graphs; got "
+                f"inputs {self.conf.network_inputs}")
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            cdt = _dtype_of(gc.compute_dtype)
+            x_t = x_t.astype(cdt)
+            params = _cast_floats(params, cdt)
+        acts = {self.conf.network_inputs[0]: x_t}
+        new_d = dict(dstate)
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if node.kind == "input":
+                continue
+            ins = [acts[i] for i in node.inputs]
+            if node.kind == "vertex":
+                acts[name] = node.vertex.apply(ins)
+                continue
+            y, nd = node.layer.decode_step(
+                params.get(name, {}), dstate.get(name), ins[0], pos,
+                state=state.get(name) if state else None)
+            new_d[name] = nd
+            acts[name] = y
+        outs = [acts[n] for n in self.conf.network_outputs]
+        return (outs[0] if len(outs) == 1 else outs), new_d
+
     def evaluate(self, data):
         """First-output classification eval, dispatched through the
         bucketed engine with the host read pipelined one batch behind the

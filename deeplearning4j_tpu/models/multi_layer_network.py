@@ -762,6 +762,35 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self):
         self._rnn_carries = None
 
+    # --------------------------------------------------- incremental decode
+    def init_decode_state(self, batch: int, max_len: int = 256):
+        """Per-layer decode state for ``batch`` concurrent streams of up to
+        ``max_len`` tokens (serving/decode.py keeps this tree resident on
+        device). Recurrent layers contribute their (h, c) carry; attention
+        a fixed-capacity KV cache; stateless layers None."""
+        gc = self.conf.global_conf
+        dt = _dtype_of(gc.compute_dtype or gc.dtype)
+        return [l.init_decode_state(p, batch, max_len, dt)
+                for l, p in zip(self.layers, self.params)]
+
+    def decode_step(self, params, state, dstate, x_t, pos):
+        """Pure one-token step through the stack: ``x_t`` (B, 1, F) input
+        slice, ``pos`` (B,) int32 per-stream position. Returns
+        ``(y, new_dstate)`` — bitwise-equal to position ``pos`` of a full
+        teacher-forced ``_forward`` on the same prefix (the compute-dtype
+        cast mirrors ``_forward`` exactly so bf16 nets stay bit-identical)."""
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            cdt = _dtype_of(gc.compute_dtype)
+            x_t = x_t.astype(cdt)
+            params = _cast_floats(params, cdt)
+        x = x_t
+        new_d = list(dstate)
+        for i, l in enumerate(self.layers):
+            x, new_d[i] = l.decode_step(params[i], dstate[i], x, pos,
+                                        state=state[i] if state else None)
+        return x, new_d
+
     # ------------------------------------------------------------- evaluate
     def _eval_stream(self, data, eval_fn):
         """Shared bucketed+pipelined evaluation core: dispatch runs one

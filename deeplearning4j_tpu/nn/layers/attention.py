@@ -119,6 +119,49 @@ class MultiHeadAttention(Layer):
             o = o + params["bo"]
         return o, state
 
+    # ---- incremental decode ----------------------------------------------
+    def init_decode_state(self, params, batch, max_len, dtype=jnp.float32):
+        """Fixed-capacity KV cache: (B, max_len, H, Dh) per tensor. Capacity
+        equals the full-forward sequence length, so the decode softmax runs
+        over the same-length axis as teacher forcing (masked positions are
+        -inf → exp 0) and stays bitwise-equal to it."""
+        H = self.n_heads
+        Dh = (self.n_out or self.n_in) // H
+        # two distinct buffers — sharing one array would make the engine's
+        # donated step donate the same buffer twice
+        return {"k": jnp.zeros((batch, max_len, H, Dh), dtype),
+                "v": jnp.zeros((batch, max_len, H, Dh), dtype)}
+
+    def decode_step(self, params, dstate, x, pos, state=None):
+        if not self.causal:
+            raise ValueError(
+                "only causal attention can decode incrementally (non-causal "
+                "heads attend to future tokens)")
+        B = x.shape[0]
+        q, k, v = self._project(params, x)              # (B, 1, H, Dh)
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+        rows = jnp.arange(B)
+        kc = dstate["k"].at[rows, pos].set(k[:, 0])
+        vc = dstate["v"].at[rows, pos].set(v[:, 0])
+        C = kc.shape[1]
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale     # (B, H, 1, C)
+        valid = jnp.arange(C)[None, :] <= pos[:, None]       # (B, C)
+        s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        # Bitwise parity trick: XLA:CPU lowers the q-length-1 contraction as
+        # a gemv whose accumulation order differs from the full forward's
+        # gemm rows in the last ulp. Broadcasting the single query row to 2
+        # rows forces the gemm path (rows are independent, so row 0 equals
+        # the teacher-forced row exactly); the duplicate row is one extra
+        # (C, Dh) dot per head — noise next to the step's dispatch cost.
+        p2 = jnp.broadcast_to(p, (B, p.shape[1], 2, C))
+        o = jnp.einsum("bhqk,bkhd->bqhd", p2, vc)[:, :1]
+        o = o.reshape(B, 1, self.n_out) @ params["Wo"]
+        if self.has_bias:
+            o = o + params["bo"]
+        return o, {"k": kc, "v": vc}
+
 
 @register_layer
 @dataclass
@@ -169,3 +212,8 @@ class PositionalEmbedding(Layer):
             raise ValueError(f"sequence length {T} exceeds "
                              f"max_len={self.max_len}")
         return x + params["P"][:T], state
+
+    def decode_step(self, params, dstate, x, pos, state=None):
+        B = x.shape[0]
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+        return x + params["P"][pos][:, None, :], dstate

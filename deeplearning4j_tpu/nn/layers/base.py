@@ -90,6 +90,28 @@ class Layer:
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
         raise NotImplementedError
 
+    # ---- incremental decode protocol (serving/decode.py) -----------------
+    # Autoregressive serving feeds ONE token per call; layers that carry
+    # sequence context expose it as explicit decode state so the whole
+    # stack becomes a fixed-shape (B, 1, F) → (B, 1, F) step the containers
+    # can jit exactly once. Stateless layers (dense, norm, activations)
+    # inherit these defaults: no state, apply() on the length-1 slice.
+    def init_decode_state(self, params, batch: int, max_len: int,
+                          dtype=jnp.float32):
+        """Per-slot decode state for a batch of ``batch`` concurrent
+        streams (None = stateless). RNNs return the (h, c) carry; attention
+        returns a fixed-capacity KV cache of ``max_len`` positions."""
+        return None
+
+    def decode_step(self, params, dstate, x, pos, state=None):
+        """One incremental token step. ``x``: (B, 1, F) activations for the
+        current position; ``pos``: (B,) int32 global position of that token
+        per stream. Returns ``(y, new_dstate)`` with y (B, 1, F_out).
+        Must be bitwise-equal to the same position of a full-sequence
+        ``apply`` (decode correctness bar — see docs/DECODING.md)."""
+        y, _ = self.apply(params, x, state, train=False, rng=None)
+        return y, dstate
+
     def has_params(self) -> bool:
         return True
 

@@ -84,8 +84,8 @@ class ConvolutionLayer(Layer):
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         # named for selective rematerialization (GlobalConf.remat =
-        # 'save_convs': keep conv outputs, recompute BN/activations);
-        # identity outside a remat context
+        # 'save_convs', alias 'selective': keep conv outputs, recompute
+        # BN/activations); identity outside a remat context
         return checkpoint_name(y, "conv_out")
 
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):

@@ -154,6 +154,36 @@ class LSTM(Layer):
         y, new_carry = self._scan(params, x, mask, carry[0], carry[1])
         return y, new_carry
 
+    # ---- incremental decode ----------------------------------------------
+    def init_decode_state(self, params, batch, max_len, dtype=jnp.float32):
+        # ``dtype`` is the container's COMPUTE dtype (params are cast to it
+        # inside decode_step), matching the carry dtype apply() derives
+        return (jnp.zeros((batch, self.n_out), dtype),
+                jnp.zeros((batch, self.n_out), dtype))
+
+    def decode_step(self, params, dstate, x, pos, state=None):
+        # Same math as one _scan iteration: the input-to-gate GEMM runs on
+        # the (B, C) slice instead of (B*T, C); _cell is shared, so
+        # GravesLSTM peepholes ride through the override automatically.
+        # The cell runs inside a trip-count-2 lax.scan on purpose: XLA:CPU
+        # fuses a while-loop body differently from straight-line code (the
+        # gate sigmoids recompute the z-add inside per-gate loop fusions),
+        # and inlines only trip-count-1 loops — so a plain call to _cell
+        # here would differ from the full forward's scan in the last ulp.
+        # Two identical iterations keep the loop (and its fusion) intact;
+        # we read iteration 0. Cost: one duplicated elementwise cell per
+        # step, noise next to the step's dispatch latency.
+        h, c = dstate
+        gate_in = x[:, 0, :] @ params["W"] + params["b"]
+
+        def body(carry, g):
+            hh, cc = self._cell(params, g, carry[0], carry[1], None)
+            return (hh, cc), (hh, cc)
+
+        _, (hs, cs) = lax.scan(body, (h, c),
+                               jnp.stack([gate_in, gate_in]))
+        return hs[0][:, None, :], (hs[0], cs[0])
+
 
 def lstm_pair_fusable(l1, l2, p1, p2, x, mask):
     """True when two consecutive LSTM layers can run as ONE wavefront
@@ -281,6 +311,23 @@ class SimpleRnn(Layer):
         _, hs = lax.scan(step, h0, xs)
         return hs.transpose(1, 0, 2), state
 
+    # ---- incremental decode ----------------------------------------------
+    def init_decode_state(self, params, batch, max_len, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def decode_step(self, params, dstate, x, pos, state=None):
+        # trip-count-2 scan for the same loop-body-fusion reason as
+        # LSTM.decode_step (see comment there)
+        act = get_activation(self.activation or "tanh")
+        gate_in = x[:, 0, :] @ params["W"] + params["b"]
+
+        def body(h, g):
+            h_new = act(g + h @ params["RW"]).astype(h.dtype)
+            return h_new, h_new
+
+        _, hs = lax.scan(body, dstate, jnp.stack([gate_in, gate_in]))
+        return hs[0][:, None, :], hs[0]
+
 
 @register_layer
 @dataclass
@@ -324,6 +371,11 @@ class Bidirectional(Layer):
             return 0.5 * (yf + yb), state
         raise ValueError(self.mode)
 
+    def decode_step(self, params, dstate, x, pos, state=None):
+        raise ValueError(
+            "Bidirectional layers consume the whole sequence (the backward "
+            "direction reads future tokens) and cannot decode incrementally")
+
 
 @register_layer
 @dataclass
@@ -357,6 +409,9 @@ class GravesBidirectionalLSTM(Layer):
     def apply(self, params, x, state=None, *, train=False, rng=None, mask=None):
         return self._build().apply(params, x, state, train=train, rng=rng, mask=mask)
 
+    def decode_step(self, params, dstate, x, pos, state=None):
+        return self._build().decode_step(params, dstate, x, pos, state=state)
+
 
 @register_layer
 @dataclass
@@ -388,6 +443,11 @@ class LastTimeStep(Layer):
         idx = T - 1 - jnp.argmax(mask[:, ::-1] > 0, axis=1).astype(jnp.int32)
         idx = jnp.where(jnp.any(mask > 0, axis=1), idx, 0)
         return y[jnp.arange(y.shape[0]), idx, :], state
+
+    def decode_step(self, params, dstate, x, pos, state=None):
+        raise ValueError(
+            "LastTimeStep collapses the time axis; it has no per-token "
+            "incremental form")
 
 
 @register_layer

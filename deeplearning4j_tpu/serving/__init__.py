@@ -14,10 +14,11 @@ See docs/SERVING.md for the design and wire format.
 from deeplearning4j_tpu.serving.engine import (
     InferenceEngine, bucket_ladder, bucket_for)
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
+from deeplearning4j_tpu.serving.decode import DecodeEngine, generate_naive
 from deeplearning4j_tpu.serving.server import InferenceServer
 from deeplearning4j_tpu.serving.client import InferenceClient
 
 __all__ = [
     "InferenceEngine", "MicroBatcher", "InferenceServer", "InferenceClient",
-    "bucket_ladder", "bucket_for",
+    "DecodeEngine", "generate_naive", "bucket_ladder", "bucket_for",
 ]

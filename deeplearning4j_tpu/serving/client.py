@@ -1,5 +1,13 @@
 """Client for the InferenceServer (JSON + Base64 f32, knn_server style).
 
+Transport: one persistent ``http.client.HTTPConnection`` per thread
+(keep-alive — the server speaks HTTP/1.1 with exact Content-Length on every
+response, so the socket survives across calls and each request skips TCP
+connect + slow-start). A dropped socket (server restarted, idle timeout,
+half-closed keep-alive) reconnects ONCE within the call before the shared
+retry policy even sees an error. ``keep_alive=False`` restores
+one-connection-per-call for debugging or aggressive LB rotation.
+
 Error mapping mirrors the server's status codes (docs/FAULT_TOLERANCE.md):
 429 → ServerOverloadedError (retryable — the shared retry primitive backs
 off and tries again), 503 → BatcherStoppedError (draining; not retryable
@@ -11,10 +19,11 @@ Connection failures retry under the same policy.
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.error
-import urllib.request
+import threading
 from typing import Optional
+from urllib.parse import urlparse
 
 import numpy as np
 
@@ -25,11 +34,11 @@ from deeplearning4j_tpu.resilience.errors import (
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 
 
-def _error_message(e: urllib.error.HTTPError) -> str:
+def _error_message(code: int, body: bytes) -> str:
     """Best-effort extraction of the structured error body
     ({"error": {"type", "message"}} — or the legacy plain string)."""
     try:
-        out = json.loads(e.read().decode())
+        out = json.loads(body.decode())
         err = out.get("error")
         if isinstance(err, dict):
             return str(err.get("message", err))
@@ -37,41 +46,96 @@ def _error_message(e: urllib.error.HTTPError) -> str:
             return str(err)
     except Exception:   # noqa: BLE001 — body unreadable; code still speaks
         pass
-    return f"HTTP {e.code}"
+    return f"HTTP {code}"
 
 
-def _typed_http_error(e: urllib.error.HTTPError) -> Exception:
-    msg = _error_message(e)
-    if e.code == 429:
+def _typed_http_error(code: int, body: bytes) -> Exception:
+    msg = _error_message(code, body)
+    if code == 429:
         return ServerOverloadedError(msg)
-    if e.code == 503:
+    if code == 503:
         return BatcherStoppedError(msg)
-    if e.code == 504:
+    if code == 504:
         return DeadlineExceededError(msg)
-    if 400 <= e.code < 500:
+    if 400 <= code < 500:
         return ValueError(msg)
     return RuntimeError(msg)
 
 
+# socket-level failures that mean "the connection died", not "the server
+# answered an error" — eligible for the in-call single reconnect
+_CONN_ERRORS = (http.client.RemoteDisconnected,   # ConnectionResetError kin
+                http.client.CannotSendRequest,    # stale half-closed socket
+                http.client.BadStatusLine,
+                ConnectionError, BrokenPipeError, OSError)
+
+
 class InferenceClient:
-    def __init__(self, url: str, timeout: float = 30.0, retries: int = 3):
+    def __init__(self, url: str, timeout: float = 30.0, retries: int = 3,
+                 keep_alive: bool = True):
         self.url = url.rstrip("/")
+        parsed = urlparse(self.url)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or (443 if parsed.scheme == "https" else 80)
         self.timeout = timeout
+        self.keep_alive = keep_alive
         self.retry_policy = RetryPolicy(max_attempts=max(1, retries),
                                         base_delay=0.05, max_delay=1.0)
+        # one persistent connection PER THREAD — http.client connections are
+        # not thread-safe, and this client is shared across worker threads
+        self._local = threading.local()
+
+    # ------------------------------------------------------------ transport
+    def _conn(self) -> http.client.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(self.host, self.port,
+                                           timeout=self.timeout)
+            self._local.conn = c
+        return c
+
+    def close(self) -> None:
+        """Drop this thread's persistent connection (safe to call anytime;
+        the next request transparently reconnects)."""
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:   # noqa: BLE001 — already-dead socket
+                pass
+            self._local.conn = None
+
+    def _roundtrip(self, path, body, headers):
+        method = "GET" if body is None else "POST"
+        # attempt 0 may find a keep-alive socket the server already closed
+        # (restart, idle reap); reconnect once and retry within this call —
+        # a second failure is a real connection problem for the retry policy
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except TimeoutError:
+                self.close()
+                raise
+            except _CONN_ERRORS:
+                self.close()
+                if attempt:
+                    raise
 
     def _once(self, path, payload):
-        if payload is None:
-            req = urllib.request.Request(self.url + path)
-        else:
-            req = urllib.request.Request(
-                self.url + path, data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"})
+        body = None if payload is None else json.dumps(payload).encode()
+        headers = {} if body is None else {
+            "Content-Type": "application/json"}
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                out = json.loads(resp.read().decode())
-        except urllib.error.HTTPError as e:
-            raise _typed_http_error(e) from e
+            status, data = self._roundtrip(path, body, headers)
+        finally:
+            if not self.keep_alive:
+                self.close()
+        if status >= 400:
+            raise _typed_http_error(status, data)
+        out = json.loads(data.decode())
         if isinstance(out, dict) and "error" in out:
             err = out["error"]
             raise RuntimeError(err.get("message", str(err))
@@ -85,6 +149,7 @@ class InferenceClient:
                           policy=self.retry_policy,
                           component="serving_client")
 
+    # ------------------------------------------------------------------ API
     def predict(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
         """POST one request batch; a 1-D vector is treated as batch of 1
         and the batch dim stripped from the reply (server mirrors this).
@@ -97,6 +162,18 @@ class InferenceClient:
             payload["deadline_ms"] = float(deadline_ms)
         out = self._request("/predict", payload)
         return ndarray_from_b64(out["ndarray"])
+
+    def generate(self, tokens, max_new_tokens: int = 32, seed: int = 0,
+                 temperature: float = 0.0, top_k: int = 0) -> dict:
+        """POST /generate — autoregressive decoding through the server's
+        DecodeEngine. ``tokens``: prompt token ids. Returns
+        {"tokens": [generated ids], "prompt_len": int}."""
+        return self._request("/generate", {
+            "tokens": [int(t) for t in tokens],
+            "max_new_tokens": int(max_new_tokens),
+            "seed": int(seed),
+            "temperature": float(temperature),
+            "top_k": int(top_k)})
 
     def warmup(self, input_shape, max_batch=None) -> dict:
         """Pre-compile the server's bucket ladder for ``input_shape`` (a

@@ -1,0 +1,413 @@
+"""Incremental decoding engine: stateful step caches + slot-based
+continuous batching for autoregressive serving.
+
+``InferenceEngine`` (engine.py) amortizes compiles across request SHAPES;
+this module amortizes the autoregressive loop across concurrent REQUESTS.
+A naive text-generation server re-runs the full prefix forward for every
+token (O(T²) work per sequence) and batches only at request granularity —
+a long sequence blocks the batch until it finishes. Here, decode state
+(LSTM (h, c) carries, attention KV caches) stays resident on device in ONE
+batched tree of S slots, and the server batches at ITERATION granularity
+(the Orca/vLLM scheduling model): every device call advances all active
+sequences by one token, new requests claim free slots mid-flight, finished
+sequences free their slot without touching the compiled program.
+
+Design rules the tests pin:
+
+- ONE compiled program. Every step runs the same (S,)-shaped jitted
+  function (donated state buffers), regardless of which slots are active,
+  how requests arrive, or when they finish. ``trace_count`` counts XLA
+  programs exactly, engine.py-style.
+- Bitwise parity. A token decoded incrementally is bitwise-equal to the
+  same position of a teacher-forced full-prefix forward (layer contract in
+  nn/layers/base.py ``decode_step``; see docs/DECODING.md for the XLA:CPU
+  fusion subtleties this requires).
+- No state leakage. A freed slot's state is wiped INSIDE the step (reset
+  mask) when re-claimed, so slot reuse can never see a previous request's
+  carries; inactive slots are frozen by an active mask (their state is
+  bit-identical across steps they don't participate in).
+- Deterministic sampling. The PRNG key for a token is
+  ``fold_in(PRNGKey(request_seed), position)`` — a pure function of the
+  request, never of the slot index or co-tenants — so any arrival
+  schedule produces the same text for the same seed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.monitor import get_registry, trace
+from deeplearning4j_tpu.resilience.errors import (
+    BatcherStoppedError, ServerOverloadedError)
+
+
+class _Request:
+    """Host-side bookkeeping for one occupied slot."""
+
+    __slots__ = ("prompt", "max_new", "seed", "temperature", "top_k",
+                 "cursor", "generated", "future", "fresh", "t_start")
+
+    def __init__(self, prompt, max_new, seed, temperature, top_k, future):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.seed = int(seed)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.cursor = 0          # next input position to feed
+        self.generated: List[int] = []
+        self.future = future
+        self.fresh = True        # first step must wipe the slot's state
+        self.t_start = time.perf_counter()
+
+
+class DecodeEngine:
+    """Continuous-batching autoregressive decoder over a model container.
+
+    ``model`` is a MultiLayerNetwork or ComputationGraph whose layers
+    implement the incremental-decode protocol (``init_decode_state`` /
+    ``decode_step``) and whose output layer emits per-token probabilities
+    (e.g. RnnOutputLayer softmax). Inputs are token ids; the engine
+    one-hots them on device to the model's input width.
+
+        eng = DecodeEngine(net, slots=32, max_len=256).start()
+        toks = eng.generate([3, 1, 4], max_new_tokens=64)["tokens"]
+
+    ``slots``: concurrent streams held in the batched state tree.
+    ``max_len``: fixed KV-cache capacity = max prompt+generated length.
+    ``eos_id``: token id that finishes a stream early (None = length only).
+    ``max_queue``: bound on waiting requests (beyond it: overload error,
+    HTTP 429 through the server).
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, model, slots: int = 8, max_len: int = 256,
+                 eos_id: Optional[int] = None, max_queue: int = 256):
+        self.model = model
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self.max_queue = int(max_queue)
+        self._is_graph = hasattr(model.conf, "network_inputs")
+        itype = (model.conf.input_types[0] if self._is_graph
+                 else model.conf.input_type)
+        self.vocab = itype.size
+        self.warmup_seconds: Optional[float] = None
+
+        self._step = jax.jit(self._step_impl, donate_argnums=(2,))
+        self._dstate = None
+        self._slot_reqs: List[Optional[_Request]] = [None] * self.slots
+        self._queue: deque = deque()
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._decode_seconds = 0.0
+
+        self.id = f"decode{next(DecodeEngine._ids)}"
+        reg = get_registry()
+        lab = {"engine": self.id}
+        self._m_compiled = reg.counter(
+            "dl4jtpu_decode_compiled_programs_total",
+            "XLA programs traced for the batched decode step (design "
+            "target: exactly one per model).", ("engine",)).labels(**lab)
+        self._m_steps = reg.counter(
+            "dl4jtpu_decode_steps_total",
+            "Batched decode-step device calls.", ("engine",)).labels(**lab)
+        self._m_tokens = reg.counter(
+            "dl4jtpu_decode_tokens_total",
+            "Tokens generated (sampled outputs only — prefill positions "
+            "are not counted).", ("engine",)).labels(**lab)
+        self._m_requests = reg.counter(
+            "dl4jtpu_decode_requests_total",
+            "Generation requests completed.", ("engine",)).labels(**lab)
+        self._m_occupancy = reg.gauge(
+            "dl4jtpu_decode_active_slots",
+            "Slots occupied by live streams at the last step.",
+            ("engine",)).labels(**lab)
+        self._m_token_seconds = reg.histogram(
+            "dl4jtpu_decode_token_seconds",
+            "Per-token latency: wall seconds of one batched step (every "
+            "active stream advances one token per step).",
+            ("engine",)).labels(**lab)
+
+    @property
+    def trace_count(self) -> int:
+        return int(self._m_compiled.value)
+
+    # ------------------------------------------------------------- the step
+    def _step_impl(self, params, state, dstate, tokens, pos, reset, active,
+                   seeds, temps, topk):
+        """ONE iteration for all S slots. All arguments are (S,)-shaped, so
+        every call shares a single XLA program; scheduling decisions ride in
+        as data (masks), never as shapes."""
+        self._m_compiled.inc()   # traced-only: exact compiled-program count
+        S = self.slots
+
+        def wipe(a):
+            r = reset.reshape((S,) + (1,) * (a.ndim - 1))
+            return jnp.where(r, jnp.zeros_like(a), a)
+
+        # re-claimed slots start from zero state INSIDE the step — claiming
+        # a slot never needs a second program, and stale carries can't leak
+        dstate = jax.tree_util.tree_map(wipe, dstate)
+        x = jax.nn.one_hot(tokens, self.vocab, dtype=jnp.float32)[:, None, :]
+        y, new_d = self.model.decode_step(params, state, dstate, x, pos)
+
+        probs = y[:, 0, :]
+        logits = jnp.log(probs)      # output layer emits probs; log is
+        V = logits.shape[-1]         # monotone so sampling is equivalent
+        k = jnp.where(topk > 0, jnp.clip(topk, 1, V), V)
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        thr = jnp.take_along_axis(sorted_l, (k - 1)[:, None], axis=-1)
+        logits = jnp.where(logits >= thr, logits, -jnp.inf)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        safe_t = jnp.where(temps > 0, temps, 1.0).astype(logits.dtype)
+
+        def sample(seed, p, row):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), p)
+            return jax.random.categorical(key, row)
+
+        sampled = jax.vmap(sample)(seeds, pos,
+                                   logits / safe_t[:, None]).astype(jnp.int32)
+        next_tok = jnp.where(temps > 0, sampled, greedy)
+        next_tok = jnp.where(active, next_tok, 0)
+
+        def freeze(new, old):
+            a = active.reshape((S,) + (1,) * (new.ndim - 1))
+            return jnp.where(a, new, old)
+
+        # inactive slots keep their state bit-identical (numerically inert)
+        new_d = jax.tree_util.tree_map(freeze, new_d, dstate)
+        return next_tok, new_d
+
+    # ------------------------------------------------------------ lifecycle
+    def _ensure_dstate(self):
+        if self._dstate is None:
+            self._dstate = self.model.init_decode_state(self.slots,
+                                                        self.max_len)
+
+    def start(self) -> "DecodeEngine":
+        self._ensure_dstate()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        err = BatcherStoppedError("decode engine stopped")
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+            live = [r for r in self._slot_reqs if r is not None]
+            self._slot_reqs = [None] * self.slots
+        for r in pending + live:
+            if not r.future.done():
+                r.future.set_exception(err)
+
+    def warmup(self):
+        """Compile the (single) decode-step program through the persistent
+        compile cache before the first request — runs one all-inactive step
+        so a fresh process pays ~0 compile on its first ``generate``."""
+        from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
+        setup_compile_cache()
+        self._ensure_dstate()
+        if self._thread is not None and self._thread.is_alive():
+            return self.warmup_seconds    # loop thread owns the state now
+        S = self.slots
+        z = np.zeros(S, np.int32)
+        f = np.zeros(S, bool)
+        t0 = time.perf_counter()
+        tok, self._dstate = self._step(
+            self.model.params, self.model.state, self._dstate, z, z, f, f,
+            np.zeros(S, np.uint32), np.zeros(S, np.float32), z)
+        jax.block_until_ready(tok)
+        self.warmup_seconds = time.perf_counter() - t0
+        return self.warmup_seconds
+
+    # ------------------------------------------------------------ scheduler
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               seed: int = 0, temperature: float = 0.0,
+               top_k: int = 0) -> Future:
+        """Enqueue one generation request; returns a Future resolving to
+        ``{"tokens": [...], "prompt_len": int}``."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token id")
+        if not all(0 <= t < self.vocab for t in prompt):
+            raise ValueError(f"token ids must be in [0, {self.vocab})")
+        if len(prompt) + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens})"
+                f" exceeds engine capacity max_len={self.max_len}")
+        if self._stop.is_set() and self._thread is not None:
+            raise BatcherStoppedError("decode engine stopped")
+        fut = Future()
+        req = _Request(prompt, max_new_tokens, seed, temperature, top_k, fut)
+        with self._cv:
+            if len(self._queue) >= self.max_queue:
+                raise ServerOverloadedError(
+                    f"decode queue full ({self.max_queue})")
+            self._queue.append(req)
+            self._cv.notify_all()
+        return fut
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 32,
+                 seed: int = 0, temperature: float = 0.0,
+                 top_k: int = 0, timeout: Optional[float] = None) -> dict:
+        """Blocking ``submit`` — the one-call API the HTTP endpoint uses."""
+        return self.submit(prompt, max_new_tokens, seed, temperature,
+                           top_k).result(timeout=timeout)
+
+    def _admit_locked(self):
+        for i in range(self.slots):
+            if not self._queue:
+                break
+            if self._slot_reqs[i] is None:
+                self._slot_reqs[i] = self._queue.popleft()
+
+    def _loop(self):
+        S = self.slots
+        while not self._stop.is_set():
+            with self._cv:
+                self._admit_locked()
+                live = [(i, r) for i, r in enumerate(self._slot_reqs)
+                        if r is not None]
+                if not live:
+                    self._cv.wait(timeout=0.05)
+                    continue
+            tokens = np.zeros(S, np.int32)
+            pos = np.zeros(S, np.int32)
+            reset = np.zeros(S, bool)
+            active = np.zeros(S, bool)
+            seeds = np.zeros(S, np.uint32)
+            temps = np.zeros(S, np.float32)
+            topk = np.zeros(S, np.int32)
+            for i, r in live:
+                active[i] = True
+                reset[i] = r.fresh
+                r.fresh = False
+                p = r.cursor
+                tokens[i] = (r.prompt[p] if p < len(r.prompt)
+                             else r.generated[-1])
+                pos[i] = p
+                seeds[i] = r.seed & 0xFFFFFFFF
+                temps[i] = r.temperature
+                topk[i] = r.top_k
+            t0 = time.perf_counter()
+            with trace.span("decode_step", active=len(live)):
+                nt, self._dstate = self._step(
+                    self.model.params, self.model.state, self._dstate,
+                    tokens, pos, reset, active, seeds, temps, topk)
+                nt = np.asarray(nt)
+            dt = time.perf_counter() - t0
+            self._decode_seconds += dt
+            self._m_steps.inc()
+            self._m_occupancy.set(len(live))
+            self._m_token_seconds.observe(dt)
+            done = []
+            for i, r in live:
+                r.cursor += 1
+                if r.cursor < len(r.prompt):
+                    continue                     # still prefilling
+                tok = int(nt[i])
+                r.generated.append(tok)
+                self._m_tokens.inc()
+                if ((self.eos_id is not None and tok == self.eos_id)
+                        or len(r.generated) >= r.max_new
+                        or r.cursor >= self.max_len):
+                    done.append((i, r))
+            for i, r in done:
+                with self._cv:
+                    self._slot_reqs[i] = None    # freed; wiped on re-claim
+                self._m_requests.inc()
+                r.future.set_result({"tokens": r.generated,
+                                     "prompt_len": len(r.prompt)})
+        self._m_occupancy.set(0)
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._cv:
+            occupied = sum(r is not None for r in self._slot_reqs)
+            queued = len(self._queue)
+        toks = self._m_tokens.value
+        return {"id": self.id,
+                "slots": self.slots,
+                "max_len": self.max_len,
+                "occupied_slots": occupied,
+                "queued_requests": queued,
+                "compiled_programs": self.trace_count,
+                "steps": int(self._m_steps.value),
+                "tokens": int(toks),
+                "requests": int(self._m_requests.value),
+                "decode_seconds": self._decode_seconds,
+                "tokens_per_second": (toks / self._decode_seconds
+                                      if self._decode_seconds else 0.0),
+                "warmup_seconds": self.warmup_seconds}
+
+
+def generate_naive(model, prompt: Sequence[int], max_new_tokens: int,
+                   max_len: int, seed: int = 0, temperature: float = 0.0,
+                   top_k: int = 0, _cache={}):
+    """Baseline generator: re-runs the FULL prefix forward for every token
+    (what serving looks like without decode state) — the bench.py decode
+    row's comparison point. Pads to a fixed ``max_len`` so it compiles once,
+    and samples with the same fold_in(PRNGKey(seed), position) rule as
+    DecodeEngine, so greedy outputs match the engine token-for-token."""
+    is_graph = hasattr(model.conf, "network_inputs")
+    itype = (model.conf.input_types[0] if is_graph else model.conf.input_type)
+    vocab = itype.size
+
+    key = (id(model), max_len)
+    step = _cache.get(key)
+    if step is None:
+        def step(params, state, x, last, seed_, temp, tk):
+            if is_graph:
+                acts, _, _ = model._forward(params, state, [x],
+                                            train=False, rng=None)
+                probs = acts[model.conf.network_outputs[0]]
+            else:
+                probs, _, _ = model._forward(params, state, x,
+                                             train=False, rng=None)
+            logits = jnp.log(probs[0, last])
+            V = logits.shape[-1]
+            k = jnp.where(tk > 0, jnp.clip(tk, 1, V), V)
+            thr = jnp.sort(logits)[::-1][k - 1]
+            logits = jnp.where(logits >= thr, logits, -jnp.inf)
+            greedy = jnp.argmax(logits).astype(jnp.int32)
+            rk = jax.random.fold_in(jax.random.PRNGKey(seed_), last)
+            safe_t = jnp.where(temp > 0, temp, 1.0).astype(logits.dtype)
+            sampled = jax.random.categorical(rk, logits / safe_t)
+            return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+
+        step = _cache[key] = jax.jit(step)
+
+    toks = [int(t) for t in prompt]
+    if len(toks) + max_new_tokens > max_len:
+        raise ValueError("prompt + max_new_tokens exceeds max_len")
+    out = []
+    x = np.zeros((1, max_len, vocab), np.float32)
+    x[0, np.arange(len(toks)), toks] = 1.0
+    for _ in range(max_new_tokens):
+        last = len(toks) - 1
+        tok = int(step(model.params, model.state, jnp.asarray(x),
+                       np.int32(last), np.uint32(seed & 0xFFFFFFFF),
+                       np.float32(temperature), np.int32(top_k)))
+        out.append(tok)
+        x[0, len(toks), tok] = 1.0
+        toks.append(tok)
+    return {"tokens": out, "prompt_len": len(prompt)}

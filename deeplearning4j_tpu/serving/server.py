@@ -42,7 +42,8 @@ from deeplearning4j_tpu.resilience.errors import (
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 
-_KNOWN_PATHS = ("/predict", "/warmup", "/stats", "/metrics", "/healthz")
+_KNOWN_PATHS = ("/predict", "/generate", "/warmup", "/stats", "/metrics",
+                "/healthz")
 
 
 def _http_metrics():
@@ -60,6 +61,12 @@ class BadRequestError(ValueError):
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1 enables keep-alive: clients reuse one TCP connection across
+    # requests instead of paying connect + slow-start per call. Safe here
+    # because every response path (_json/_error/_text) sets an exact
+    # Content-Length, which 1.1 persistence requires.
+    protocol_version = "HTTP/1.1"
+
     def log_message(self, *args):
         pass
 
@@ -129,6 +136,8 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 if path == "/predict":
                     self._predict(srv, payload)
+                elif path == "/generate":
+                    self._generate(srv, payload)
                 elif path == "/warmup":
                     try:
                         shape = payload["input_shape"]
@@ -188,6 +197,29 @@ class _Handler(BaseHTTPRequestHandler):
             out = out[0]
         self._json({"ndarray": ndarray_to_b64(out)})
 
+    def _generate(self, srv, payload):
+        if srv.decode_engine is None:
+            self._error(404, "not_found",
+                        "no decode engine configured on this server")
+            return
+        try:
+            tokens = payload["tokens"]
+        except KeyError:
+            raise BadRequestError("payload missing 'tokens'") from None
+        if (not isinstance(tokens, list)
+                or not all(isinstance(t, int) for t in tokens)):
+            raise BadRequestError("'tokens' must be a list of token ids")
+        try:
+            out = srv.decode_engine.generate(
+                tokens,
+                max_new_tokens=int(payload.get("max_new_tokens", 32)),
+                seed=int(payload.get("seed", 0)),
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)))
+        except ValueError as e:     # capacity / id-range problems → 400
+            raise BadRequestError(str(e)) from None
+        self._json(out)
+
 
 class InferenceServer:
     """Serve a model container over HTTP through bucketed micro-batching.
@@ -204,8 +236,12 @@ class InferenceServer:
                  max_batch: int = 256, max_latency_ms: float = 2.0,
                  engine: Optional[InferenceEngine] = None,
                  max_queue: int = 1024,
-                 request_timeout_ms: Optional[float] = None):
+                 request_timeout_ms: Optional[float] = None,
+                 decode_engine=None):
         self.engine = engine or InferenceEngine(model)
+        # serving/decode.DecodeEngine for POST /generate (None = endpoint
+        # answers 404; predict-only servers don't pay for decode slots)
+        self.decode_engine = decode_engine
         self.batcher = MicroBatcher(self.engine, max_batch=max_batch,
                                     max_latency_ms=max_latency_ms,
                                     max_queue=max_queue)
@@ -249,14 +285,19 @@ class InferenceServer:
         return "ok"
 
     def stats(self) -> dict:
-        return {"engine": self.engine.stats(),
-                "batcher": self.batcher.stats(),
-                "health": self.health(),
-                "last_error": self.last_error}
+        out = {"engine": self.engine.stats(),
+               "batcher": self.batcher.stats(),
+               "health": self.health(),
+               "last_error": self.last_error}
+        if self.decode_engine is not None:
+            out["decode"] = self.decode_engine.stats()
+        return out
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceServer":
         self.batcher.start()
+        if self.decode_engine is not None:
+            self.decode_engine.start()
         self._httpd = ThreadingHTTPServer((self._host, self._port_req),
                                           _Handler)
         self._httpd.inference = self
@@ -271,6 +312,8 @@ class InferenceServer:
         listener. Requests arriving mid-drain get fast 503s, not hangs."""
         self._draining.set()
         self.batcher.stop()
+        if self.decode_engine is not None:
+            self.decode_engine.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -274,3 +274,86 @@ def test_http_concurrent_clients_share_device_calls():
         assert srv.batcher.stats()["device_calls"] < 12
     finally:
         srv.stop()
+
+
+# --------------------------------------------------------------- keep-alive
+
+def test_http_keep_alive_reuses_and_reconnects():
+    net = _mlp()
+    srv = InferenceServer(net, port=0, max_latency_ms=5.0).start()
+    try:
+        cli = InferenceClient(f"http://127.0.0.1:{srv.port}")
+        assert cli.health()["status"] == "ok"
+        c1 = cli._conn()
+        assert c1.sock is not None            # server kept the socket open
+        cli.stats()
+        assert cli._conn() is c1              # same connection, no re-dial
+        # a dead keep-alive socket (server restart, idle reap) reconnects
+        # once inside the call instead of failing the request
+        c1.sock.close()
+        assert cli.health()["status"] == "ok"
+        assert cli._conn() is not c1
+        # opt-out path: one connection per call still works
+        cold = InferenceClient(f"http://127.0.0.1:{srv.port}",
+                               keep_alive=False)
+        assert cold.health()["status"] == "ok"
+        assert getattr(cold._local, "conn", None) is None
+        # each worker thread gets its OWN persistent connection
+        seen = {}
+
+        def probe(i):
+            cli.health()
+            seen[i] = cli._conn()
+
+        ts = [threading.Thread(target=probe, args=(i,)) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        conns = list(seen.values()) + [cli._conn()]
+        assert len({id(c) for c in conns}) == len(conns)
+    finally:
+        srv.stop()
+
+
+def test_http_server_speaks_http11():
+    net = _mlp()
+    srv = InferenceServer(net, port=0).start()
+    try:
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/healthz")
+        r1 = conn.getresponse()
+        assert r1.version == 11
+        assert r1.getheader("Content-Length") is not None
+        r1.read()
+        # errors carry Content-Length too — required for 1.1 persistence
+        conn.request("GET", "/no-such-path")
+        r2 = conn.getresponse()
+        assert r2.status == 404
+        assert r2.getheader("Content-Length") is not None
+        r2.read()
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_warmed_server_serves_first_predict_without_new_compiles():
+    """Regression (compile-cache contract): after /warmup walks the bucket
+    ladder through the persistent compile cache, the FIRST real /predict —
+    over real HTTP — must ride an already-compiled program: trace_count
+    (exact compiled-program counter) stays unchanged."""
+    net = _mlp()
+    srv = InferenceServer(net, port=0, max_latency_ms=2.0).start()
+    try:
+        cli = InferenceClient(f"http://127.0.0.1:{srv.port}")
+        cli.warmup([4], max_batch=8)
+        compiled = cli.stats()["engine"]["compiled_programs"]
+        assert compiled >= 4                  # ladder [1, 2, 4, 8]
+        rs = np.random.RandomState(12)
+        x = rs.rand(3, 4).astype(np.float32)
+        out = cli.predict(x)
+        assert np.array_equal(out, np.asarray(net.output(x, bucketed=False)))
+        assert cli.stats()["engine"]["compiled_programs"] == compiled
+    finally:
+        srv.stop()
