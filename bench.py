@@ -83,6 +83,9 @@ BARS = {
     "decode": 2000.0,         # tokens/sec, autoregressive 2xLSTM(256)
                               # char generation (cuDNN rnnTimeStep loop,
                               # request-granularity batching)
+    "router": 1000.0,         # req/sec aggregate through a 3-replica
+                              # routed tier (ParallelInference behind a
+                              # round-robin LB, small-model requests)
 }
 
 V5E_PEAK_FLOPS = 197e12       # bf16 MXU peak of one v5e chip (MFU denominator)
@@ -798,6 +801,100 @@ def bench_decode(max_len=256, gen_tokens=128, streams=32):
          "warmup_seconds": round(eng.warmup_seconds, 2)})
 
 
+def bench_router(threads=6, requests_per_thread=24):
+    """Router row: aggregate QPS + request p50/p99 through the replicated
+    serving tier (serving/router.py) — 1 subprocess charlstm replica vs 3,
+    same mixed /predict+/generate storm, with a mid-run SIGKILL of one
+    replica in the 3-way phase. The claims this row pins: the tier
+    absorbs a replica crash with ZERO failed requests (failover + retry
+    budget), and replication scales aggregate QPS. NOTE: replicas are
+    separate Python processes — the 3-replica speedup needs ≥3 usable
+    cores; ``cpu_count`` rides in the row so a 1-core box's number is
+    read for what it is (there, the robustness claim is the row's point).
+    """
+    import statistics
+    import tempfile
+    import threading as _threading
+    from deeplearning4j_tpu.resilience.faults import kill_replica
+    from deeplearning4j_tpu.serving import (InferenceClient, ReplicaProcess,
+                                            Router)
+
+    workdir = tempfile.mkdtemp(prefix="bench_router_")
+    n_req = threads * requests_per_thread
+
+    def storm(n_replicas, kill_one):
+        reps = [ReplicaProcess(workdir, model="charlstm",
+                               name=f"bench{n_replicas}_{i}").start()
+                for i in range(n_replicas)]
+        for r in reps:
+            r.wait_ready()
+        router = Router([r.url for r in reps], port=0, probe_interval=0.25,
+                        hedge=True, hedge_delay_ms=250.0,
+                        upstream_timeout=120.0).start()
+        base = f"http://127.0.0.1:{router.port}"
+        lats, failures, lock = [], [], _threading.Lock()
+        done = [0]
+
+        def worker(seed):
+            rs = np.random.RandomState(seed)
+            c = InferenceClient(base, retries=1, timeout=120.0)
+            for _ in range(requests_per_thread):
+                t0 = time.perf_counter()
+                try:
+                    if rs.rand() < 0.5:
+                        x = np.zeros((2, 6, 16), np.float32)
+                        x[:, np.arange(6), rs.randint(0, 16, 6)] = 1.0
+                        c.predict(x)
+                    else:
+                        c.generate(rs.randint(0, 16, 3).tolist(),
+                                   max_new_tokens=6, seed=int(seed))
+                    with lock:
+                        lats.append(time.perf_counter() - t0)
+                        done[0] += 1
+                except Exception as e:   # noqa: BLE001 — counted, fatal
+                    with lock:
+                        failures.append(repr(e))
+            c.close()
+
+        # steady-state the tier (compiles, conn pools) before the timed span
+        warm = InferenceClient(base)
+        warm.generate([1, 2], max_new_tokens=2)
+        warm.close()
+
+        ts = [_threading.Thread(target=worker, args=(i,))
+              for i in range(threads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        if kill_one:
+            while done[0] < n_req // 3:      # storm established → crash
+                time.sleep(0.01)
+            kill_replica(reps[0].proc)
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        router.stop()
+        for r in reps:
+            r.stop()
+        assert not failures, failures[:3]
+        return (len(lats) / wall,
+                statistics.median(lats) * 1e3,
+                sorted(lats)[max(0, int(0.99 * len(lats)) - 1)] * 1e3)
+
+    qps1, p50_1, p99_1 = storm(1, kill_one=False)
+    qps3, p50_3, p99_3 = storm(3, kill_one=True)
+    return _emit(
+        "router (3 charlstm replicas, mixed predict+generate, "
+        "mid-run SIGKILL)", qps3, "req/sec", BARS["router"],
+        {"p50_ms": round(p50_3, 1), "p99_ms": round(p99_3, 1),
+         "qps_1_replica": round(qps1, 1),
+         "p50_ms_1_replica": round(p50_1, 1),
+         "p99_ms_1_replica": round(p99_1, 1),
+         "speedup_3_vs_1": round(qps3 / qps1, 2),
+         "failed_requests": 0,
+         "cpu_count": os.cpu_count()})
+
+
 def bench_word2vec(n_tokens=200_000, vocab=2000, dim=100):
     """Skip-gram negative sampling, end-to-end fit on a synthetic Zipf corpus
     (vocab build excluded; pair generation + device steps included — the
@@ -1100,6 +1197,7 @@ BENCHES = {
     "input_pipeline": bench_input_pipeline,
     "serving": bench_serving,
     "decode": bench_decode,
+    "router": bench_router,
     "observability": bench_observability,
     "robustness": bench_robustness,
     "word2vec": bench_word2vec,
@@ -1118,7 +1216,8 @@ BENCHES = {
 _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "resnet50": 150, "lenet": 90, "vgg16": 90, "input_pipeline": 120,
         "parallelwrapper": 150, "word2vec": 120, "serving": 120,
-        "decode": 150, "observability": 100, "robustness": 100}
+        "decode": 150, "observability": 100, "robustness": 100,
+        "router": 150}
 
 
 def main(argv=None):

@@ -11,7 +11,9 @@ from deeplearning4j_tpu.resilience.errors import (
     CorruptCheckpointError,
     DeadlineExceededError,
     FatalError,
+    InjectedFaultError,
     RetriesExhaustedError,
+    RetryBudgetExhaustedError,
     ServerOverloadedError,
     StreamStalledError,
     TransientError,
@@ -39,7 +41,9 @@ __all__ = [
     "DEFAULT_POLICY",
     "DeadlineExceededError",
     "FatalError",
+    "InjectedFaultError",
     "RetriesExhaustedError",
+    "RetryBudgetExhaustedError",
     "RetryPolicy",
     "ServerOverloadedError",
     "StreamStalledError",

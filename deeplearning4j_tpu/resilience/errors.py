@@ -53,6 +53,25 @@ class BatcherStoppedError(RuntimeError):
     instance."""
 
 
+class RetryBudgetExhaustedError(RuntimeError):
+    """The router's shared retry budget is spent: failover/hedging stops and
+    the client gets a FAST 503 instead of queueing behind doomed attempts.
+    The budget exists so retries cannot amplify a brownout into a retry
+    storm — when every replica is failing, added attempts only add load."""
+
+
+class InjectedFaultError(RuntimeError):
+    """A chaos-injected server fault (resilience/faults.py
+    ``ServerFaultInjector``). Carries the HTTP status the injection site
+    should answer with, so the serving layer maps it without string
+    matching. Test-only in practice, but defined here so production code
+    never has to import the faults module to classify it."""
+
+    def __init__(self, message: str, code: int = 500):
+        super().__init__(message)
+        self.code = int(code)
+
+
 class CorruptCheckpointError(ValueError):
     """A checkpoint zip is truncated or damaged. Raised by
     util/model_serializer.py with the missing/unreadable member named, so a

@@ -15,6 +15,16 @@ tests/test_resilience.py rather than to ship in a training loop:
 - ``FlakyEngine`` — wraps an inference engine; scripted delays and
   failures drive the serving storm tests (expired deadlines, 429s, engine
   faults → 500).
+- ``ServerFaultInjector`` — PROCESS-LEVEL chaos at a replica server:
+  injected latency and 5xx on /predict//generate, reconfigurable live over
+  ``POST /chaos`` so the router chaos soak can brown out a subprocess
+  replica it cannot reach in-process.
+- ``BlackholeProxy`` — a TCP forwarder in front of a replica that can
+  black-hole its socket (accept, then forward nothing): connects succeed
+  but every request hangs until the client's timeout — the failure mode
+  health checks exist for, distinct from connection-refused.
+- ``kill_replica`` — SIGKILL a replica process: the real crash, no drain,
+  no goodbye (the chaos soak's mid-storm kill).
 
 ``SimulatedCrash`` subclasses BaseException on purpose: production code is
 entitled to ``except Exception`` around batches, and a simulated kill must
@@ -23,12 +33,18 @@ not be swallowable by any of it — exactly like a real SIGKILL isn't.
 
 from __future__ import annotations
 
+import os
+import signal
+import socket
 import threading
 import time
 from typing import Dict, Optional
 
+from deeplearning4j_tpu.resilience.errors import InjectedFaultError
+
 __all__ = ["SimulatedCrash", "CrashAfter", "FlakyIterator", "FlakyBroker",
-           "FlakyEngine"]
+           "FlakyEngine", "ServerFaultInjector", "BlackholeProxy",
+           "kill_replica"]
 
 
 class SimulatedCrash(BaseException):
@@ -176,3 +192,166 @@ class FlakyEngine:
 
     def __getattr__(self, name):
         return getattr(self._base, name)
+
+
+class ServerFaultInjector:
+    """Replica-server chaos: latency and 5xx injection on /predict and
+    /generate, reconfigurable at runtime (the server exposes it at
+    ``POST /chaos`` when constructed with one of these).
+
+    ``latency_ms``: sleep inside every handled request (brownout).
+    ``fail_next``: deterministically fail the next N requests.
+    ``fail_rate``: additionally fail this fraction of requests, decided by
+    a seeded counter (every ``round(1/rate)``-th request) so a chaos run is
+    reproducible — no RNG, no flaky tests.
+    ``error_code``: status for injected failures (500 by default; 503
+    exercises the router's draining-vs-fault classification).
+    """
+
+    def __init__(self, latency_ms: float = 0.0, fail_next: int = 0,
+                 fail_rate: float = 0.0, error_code: int = 500):
+        self._lock = threading.Lock()
+        self.configure(latency_ms=latency_ms, fail_next=fail_next,
+                       fail_rate=fail_rate, error_code=error_code)
+        self.injected_faults = 0
+        self.requests_seen = 0
+
+    def configure(self, latency_ms=None, fail_next=None, fail_rate=None,
+                  error_code=None, **_ignored):
+        with self._lock:
+            if latency_ms is not None:
+                self.latency_ms = float(latency_ms)
+            if fail_next is not None:
+                self.fail_next = int(fail_next)
+            if fail_rate is not None:
+                self.fail_rate = float(fail_rate)
+            if error_code is not None:
+                self.error_code = int(error_code)
+
+    def describe(self) -> dict:
+        return {"latency_ms": self.latency_ms, "fail_next": self.fail_next,
+                "fail_rate": self.fail_rate, "error_code": self.error_code,
+                "injected_faults": self.injected_faults,
+                "requests_seen": self.requests_seen}
+
+    def maybe_inject(self, path: str = "") -> None:
+        with self._lock:
+            self.requests_seen += 1
+            n = self.requests_seen
+            latency = self.latency_ms
+            fail = False
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                fail = True
+            elif self.fail_rate > 0:
+                every = max(1, round(1.0 / self.fail_rate))
+                fail = (n % every) == 0
+            if fail:
+                self.injected_faults += 1
+                code = self.error_code
+        if latency > 0:
+            time.sleep(latency / 1000.0)
+        if fail:
+            raise InjectedFaultError(
+                f"chaos-injected fault on {path or 'request'} #{n}",
+                code=code)
+
+
+class BlackholeProxy:
+    """TCP proxy that can stop forwarding on command.
+
+    Route a replica's traffic through ``proxy = BlackholeProxy(replica_port)
+    .start()`` and point the router at ``proxy.port``. In ``blackhole``
+    mode, new connections are ACCEPTED and then starved — no bytes flow
+    either way — so the router sees hangs-until-timeout, the slow-failure
+    mode that only deadline-aware health checking catches (a dead process
+    at least refuses connections fast).
+    """
+
+    def __init__(self, target_port: int, target_host: str = "127.0.0.1",
+                 port: int = 0):
+        self.target = (target_host, int(target_port))
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._blackholed = threading.Event()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._open: list = []
+        self._lock = threading.Lock()
+
+    def start(self) -> "BlackholeProxy":
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def blackhole(self, on: bool = True) -> None:
+        """Starve the socket: existing connections stall mid-stream, new
+        ones accept and then hang. ``on=False`` restores forwarding for
+        NEW connections (stalled ones stay stalled, like a real partition
+        healing under old flows)."""
+        if on:
+            self._blackholed.set()
+        else:
+            self._blackholed.clear()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._open = self._open, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._open.append(client)
+            if self._blackholed.is_set():
+                continue        # accepted, never serviced: the black hole
+            try:
+                upstream = socket.create_connection(self.target, timeout=10)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._open.append(upstream)
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        try:
+            while not self._stopped.is_set():
+                data = src.recv(65536)
+                if not data or self._blackholed.is_set():
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+
+def kill_replica(proc) -> None:
+    """SIGKILL a replica process (a ``subprocess.Popen`` or anything with
+    ``.pid``): no drain, no atexit, no flushed sockets — the genuine crash
+    the failover path must absorb."""
+    os.kill(proc.pid, signal.SIGKILL)

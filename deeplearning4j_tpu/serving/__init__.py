@@ -8,7 +8,12 @@ device call, and an HTTP endpoint in the knn_server style. The reference has
 no serving layer at all — its ``output()`` dispatches per-op over JNI
 (MultiLayerNetwork.java:1947) — so this is where the XLA-native build wins.
 
-See docs/SERVING.md for the design and wire format.
+Above the single server sits the replicated tier (``router``/``replica``):
+a failover router with per-replica health state machines, hedged requests,
+a shared retry budget, tenant quotas, and health-gated rolling restarts.
+
+See docs/SERVING.md for the design and wire format, docs/DECODING.md for
+/generate, and docs/SERVING_TIER.md for the replicated tier.
 """
 
 from deeplearning4j_tpu.serving.engine import (
@@ -17,8 +22,12 @@ from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.decode import DecodeEngine, generate_naive
 from deeplearning4j_tpu.serving.server import InferenceServer
 from deeplearning4j_tpu.serving.client import InferenceClient
+from deeplearning4j_tpu.serving.router import RetryBudget, Router
+from deeplearning4j_tpu.serving.replica import (
+    InProcessReplica, ReplicaProcess)
 
 __all__ = [
     "InferenceEngine", "MicroBatcher", "InferenceServer", "InferenceClient",
     "DecodeEngine", "generate_naive", "bucket_ladder", "bucket_for",
+    "Router", "RetryBudget", "ReplicaProcess", "InProcessReplica",
 ]

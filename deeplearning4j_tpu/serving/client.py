@@ -63,10 +63,15 @@ def _typed_http_error(code: int, body: bytes) -> Exception:
 
 
 # socket-level failures that mean "the connection died", not "the server
-# answered an error" — eligible for the in-call single reconnect
+# answered an error" — eligible for the in-call single reconnect.
+# IncompleteRead covers a connection dropped MID-RESPONSE (headers arrived,
+# the body didn't — a replica SIGKILLed between write() calls): without it
+# only pre-send drops reconnected, and a /generate whose socket died after
+# headers surfaced a raw http.client error instead of retrying.
 _CONN_ERRORS = (http.client.RemoteDisconnected,   # ConnectionResetError kin
                 http.client.CannotSendRequest,    # stale half-closed socket
                 http.client.BadStatusLine,
+                http.client.IncompleteRead,       # died after headers
                 ConnectionError, BrokenPipeError, OSError)
 
 
@@ -105,17 +110,23 @@ class InferenceClient:
                 pass
             self._local.conn = None
 
-    def _roundtrip(self, path, body, headers):
+    def _roundtrip(self, path, body, headers, reconnect=True, give_up=None):
         method = "GET" if body is None else "POST"
         # attempt 0 may find a keep-alive socket the server already closed
         # (restart, idle reap); reconnect once and retry within this call —
-        # a second failure is a real connection problem for the retry policy
-        for attempt in (0, 1):
+        # a second failure is a real connection problem for the retry policy.
+        # The reconnect covers drops BEFORE the send and MID-RESPONSE alike
+        # (IncompleteRead in _CONN_ERRORS). ``reconnect=False`` makes it one
+        # attempt only; ``give_up()`` (polled before the re-dial) lets a
+        # caller that closed our socket on purpose — a hedging router
+        # cancelling the losing attempt — abort instead of re-sending.
+        attempts = (0, 1) if reconnect else (1,)
+        for attempt in attempts:
             conn = self._conn()
             try:
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
-                return resp.status, resp.read()
+                return resp.status, resp.read(), dict(resp.getheaders())
             except TimeoutError:
                 self.close()
                 raise
@@ -123,13 +134,32 @@ class InferenceClient:
                 self.close()
                 if attempt:
                     raise
+                if give_up is not None and give_up():
+                    raise
+
+    def post_raw(self, path, body: bytes, headers=None, reconnect=True,
+                 give_up=None):
+        """Forward pre-encoded bytes and return ``(status, body, headers)``
+        WITHOUT raising on HTTP error statuses — the router's upstream
+        primitive: it owns failover/hedging, so it needs the status code as
+        data, the response headers (``x-request-id``), and the original
+        payload passed through byte-for-byte."""
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        try:
+            return self._roundtrip(path, body, hdrs, reconnect=reconnect,
+                                   give_up=give_up)
+        finally:
+            if not self.keep_alive:
+                self.close()
 
     def _once(self, path, payload):
         body = None if payload is None else json.dumps(payload).encode()
         headers = {} if body is None else {
             "Content-Type": "application/json"}
         try:
-            status, data = self._roundtrip(path, body, headers)
+            status, data, _ = self._roundtrip(path, body, headers)
         finally:
             if not self.keep_alive:
                 self.close()

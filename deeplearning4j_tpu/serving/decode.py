@@ -143,6 +143,15 @@ class DecodeEngine:
     def trace_count(self) -> int:
         return int(self._m_compiled.value)
 
+    @property
+    def saturated(self) -> bool:
+        """All S slots busy: a new /generate would queue behind a full
+        batch. /healthz reports ``degraded`` in this state so a router can
+        steer prefill-heavy work to replicas with free slots."""
+        with self._cv:
+            return (self.slots > 0
+                    and all(r is not None for r in self._slot_reqs))
+
     # ------------------------------------------------------------- the step
     def _step_impl(self, params, state, dstate, tokens, pos, reset, active,
                    seeds, temps, topk):

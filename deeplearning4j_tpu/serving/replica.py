@@ -1,0 +1,344 @@
+"""Replica workers for the routed serving tier (docs/SERVING_TIER.md).
+
+A *replica* is one PR-4/PR-5 ``InferenceServer`` (micro-batched /predict,
+optional DecodeEngine /generate) that the ``Router`` fronts. This module
+supplies the three ways a replica exists:
+
+- ``main()`` — the subprocess entrypoint
+  (``python -m deeplearning4j_tpu.serving.replica --model charlstm ...``):
+  builds a small deterministic model, serves it, writes its bound port to
+  ``--port-file`` so the parent can find an OS-assigned port, drains
+  gracefully on SIGTERM, and optionally mounts the chaos surface
+  (``--chaos`` → resilience.faults.ServerFaultInjector behind
+  ``POST /chaos``).
+- ``ReplicaProcess`` — the parent-side handle: Popen + wait_ready() +
+  stop() (SIGTERM, graceful) + kill() (SIGKILL, the chaos soak's crash) +
+  start() again on the SAME port (restart-in-place for rolling deploys).
+- ``InProcessReplica`` — an in-process InferenceServer with the same
+  handle shape, for router tests where process isolation adds nothing but
+  seconds.
+
+Models are intentionally tiny: replicas must cold-start (including XLA
+compiles) in seconds on a CPU test box, because the chaos harness
+restarts them mid-test. The persistent compile cache makes second and
+later starts near-instant.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["ReplicaProcess", "InProcessReplica", "build_model",
+           "build_server", "main"]
+
+# charlstm vocab — small so one decode step is microseconds on CPU
+CHAR_VOCAB = 16
+
+
+def build_model(name: str):
+    """Deterministic tiny models (fixed seeds: every replica of a tier has
+    bit-identical params, so failover parity is testable)."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import (DenseLayer, LSTM, OutputLayer,
+                                              RnnOutputLayer)
+    from deeplearning4j_tpu.nn.updaters import Adam
+    if name == "mlp":
+        conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2))
+                .weight_init("xavier").list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        return MultiLayerNetwork(conf).init()
+    if name == "charlstm":
+        conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2))
+                .weight_init("xavier").list()
+                .layer(LSTM(n_out=24, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=CHAR_VOCAB, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(CHAR_VOCAB))
+                .build())
+        return MultiLayerNetwork(conf).init()
+    raise ValueError(f"unknown replica model {name!r} (mlp | charlstm)")
+
+
+def build_server(model_name: str = "charlstm", port: int = 0,
+                 slots: int = 4, max_len: int = 64, max_queue: int = 256,
+                 max_latency_ms: float = 2.0, chaos: bool = False):
+    """Assemble (but don't start) a replica InferenceServer. ``charlstm``
+    serves both /predict and /generate; ``mlp`` is predict-only."""
+    from deeplearning4j_tpu.serving.decode import DecodeEngine
+    from deeplearning4j_tpu.serving.server import InferenceServer
+    net = build_model(model_name)
+    dec = None
+    if model_name == "charlstm":
+        dec = DecodeEngine(net, slots=slots, max_len=max_len,
+                           max_queue=max_queue)
+    injector = None
+    if chaos:
+        from deeplearning4j_tpu.resilience.faults import ServerFaultInjector
+        injector = ServerFaultInjector()
+    return InferenceServer(net, port=port, max_latency_ms=max_latency_ms,
+                           max_queue=max_queue, decode_engine=dec,
+                           fault_injector=injector)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="dl4jtpu serving replica worker")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--port-file", default=None,
+                        help="write the bound port here once listening")
+    parser.add_argument("--model", default="charlstm",
+                        choices=("mlp", "charlstm"))
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--max-len", type=int, default=64)
+    parser.add_argument("--max-queue", type=int, default=256)
+    parser.add_argument("--max-latency-ms", type=float, default=2.0)
+    parser.add_argument("--chaos", action="store_true",
+                        help="mount POST /chaos (test-only fault injection)")
+    parser.add_argument("--warmup", action="store_true",
+                        help="pre-compile before accepting traffic")
+    args = parser.parse_args(argv)
+
+    # CPU platform before anything touches a backend: replicas are test
+    # and bench workers, never the training accelerator's tenant
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
+    setup_compile_cache()       # restart-in-place must not recompile
+
+    srv = build_server(args.model, port=args.port, slots=args.slots,
+                       max_len=args.max_len, max_queue=args.max_queue,
+                       max_latency_ms=args.max_latency_ms, chaos=args.chaos)
+    if srv.decode_engine is not None:
+        srv.decode_engine.start()
+        if args.warmup:
+            srv.decode_engine.warmup()
+    srv.start()
+    if args.warmup and args.model == "mlp":
+        srv.engine.warmup((4,), max_batch=64)
+
+    stopping = []
+
+    def _sigterm(signum, frame):
+        # graceful drain: in-flight requests finish, /healthz flips to
+        # draining, then the process exits 0
+        stopping.append(True)
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(srv.port))
+        os.replace(tmp, args.port_file)      # atomic: parent never reads ""
+    print(f"REPLICA_READY port={srv.port} pid={os.getpid()} "
+          f"model={args.model}", flush=True)
+
+    try:
+        while not stopping:
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    if srv.decode_engine is not None:
+        srv.decode_engine.stop()
+    print("REPLICA_STOPPED", flush=True)
+    return 0
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+class ReplicaProcess:
+    """Parent-side handle for a subprocess replica.
+
+        rep = ReplicaProcess(workdir, model="charlstm").start().wait_ready()
+        ... rep.url ...
+        rep.kill()          # SIGKILL: the crash the router must absorb
+        rep.start().wait_ready()   # restart-in-place, same port
+
+    The first ``start()`` lets the OS pick a port (read back through
+    ``--port-file``); later starts reuse it so the router's upstream URL
+    stays valid across restarts (allow_reuse_address makes the rebind
+    race-free)."""
+
+    def __init__(self, workdir: str, model: str = "charlstm",
+                 slots: int = 4, max_len: int = 64,
+                 chaos: bool = True, warmup: bool = True,
+                 name: str = "replica"):
+        self.workdir = workdir
+        self.model = model
+        self.slots = slots
+        self.max_len = max_len
+        self.chaos = chaos
+        self.warmup = warmup
+        self.name = name
+        self.port: Optional[int] = None
+        self.proc: Optional[subprocess.Popen] = None
+        self._log = os.path.join(workdir, f"{name}.log")
+        self._port_file = os.path.join(workdir, f"{name}.port")
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "ReplicaProcess":
+        if os.path.exists(self._port_file) and self.port is None:
+            os.unlink(self._port_file)
+        cmd = [sys.executable, "-m", "deeplearning4j_tpu.serving.replica",
+               "--model", self.model, "--slots", str(self.slots),
+               "--max-len", str(self.max_len),
+               "--port", str(self.port or 0),
+               "--port-file", self._port_file]
+        if self.chaos:
+            cmd.append("--chaos")
+        if self.warmup:
+            cmd.append("--warmup")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (_repo_root() + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        # log to a FILE: a full stdout pipe would deadlock a replica that
+        # nobody is reading, and post-mortems want the log anyway
+        self._logf = open(self._log, "ab")
+        self.proc = subprocess.Popen(cmd, stdout=self._logf,
+                                     stderr=subprocess.STDOUT, env=env,
+                                     cwd=self.workdir)
+        return self
+
+    def wait_ready(self, timeout: float = 180.0) -> "ReplicaProcess":
+        """Block until the replica's /healthz answers ok (covers the
+        port-file handshake AND warmup compiles)."""
+        from deeplearning4j_tpu.serving.client import InferenceClient
+        deadline = time.monotonic() + timeout
+        while self.port is None:
+            if os.path.exists(self._port_file):
+                with open(self._port_file) as f:
+                    text = f.read().strip()
+                if text:
+                    self.port = int(text)
+                    break
+            if self.proc is not None and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {self.name} exited rc={self.proc.returncode} "
+                    f"before binding; see {self._log}")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"replica {self.name} never wrote {self._port_file}")
+            time.sleep(0.05)
+        cli = InferenceClient(self.url, timeout=5.0, retries=1)
+        try:
+            while True:
+                try:
+                    if cli.health().get("status") == "ok":
+                        return self
+                except Exception:   # noqa: BLE001 — still booting
+                    pass
+                if self.proc is not None and self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {self.name} exited rc="
+                        f"{self.proc.returncode} during boot; "
+                        f"see {self._log}")
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"replica {self.name} on port {self.port} never "
+                        f"became healthy")
+                time.sleep(0.05)
+        finally:
+            cli.close()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """SIGTERM → graceful drain → exit 0."""
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        self._close_log()
+
+    def kill(self) -> None:
+        """SIGKILL: no drain, no flushed sockets — the genuine crash."""
+        if self.proc is None:
+            return
+        try:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self.proc.wait(timeout=10)
+        self._close_log()
+
+    def _close_log(self) -> None:
+        logf = getattr(self, "_logf", None)
+        if logf is not None:
+            try:
+                logf.close()
+            except OSError:
+                pass
+            self._logf = None
+
+
+class InProcessReplica:
+    """Same handle shape as ReplicaProcess, backed by an in-process
+    InferenceServer — for router tests where subprocess isolation adds
+    only wall-clock. NOTE: in-process replicas share the process-global
+    metrics registry with the router; series stay distinguishable through
+    their labels.
+
+    ``restart()`` stops the server (graceful drain) and starts a fresh one
+    on the SAME port — the restarter hook ``Router.rolling_restart`` wants.
+    """
+
+    def __init__(self, model: str = "mlp", chaos: bool = True, **server_kw):
+        self.model = model
+        self.chaos = chaos
+        self.server_kw = server_kw
+        self.srv = None
+        self.port: Optional[int] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def fault_injector(self):
+        return self.srv.fault_injector if self.srv else None
+
+    def start(self) -> "InProcessReplica":
+        self.srv = build_server(self.model, port=self.port or 0,
+                                chaos=self.chaos, **self.server_kw)
+        if self.srv.decode_engine is not None:
+            self.srv.decode_engine.start()
+        self.srv.start()
+        self.port = self.srv.port
+        return self
+
+    def stop(self) -> None:
+        if self.srv is not None:
+            srv, self.srv = self.srv, None
+            srv.stop()
+            if srv.decode_engine is not None:
+                srv.decode_engine.stop()
+
+    def restart(self) -> None:
+        self.stop()
+        self.start()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,823 @@
+"""Failover router: the replica tier in front of N inference servers.
+
+PR 4 made one serving process fault tolerant and PR 5 gave it a
+continuous-batching DecodeEngine; this module is the tier above — the
+layer that survives a replica dying or browning out MID-STORM without the
+client noticing (ROADMAP item 2; the design follows Dean & Barroso, "The
+Tail at Scale": replication + hedging is how you keep p99 flat when
+individual workers go slow or dead). Everything is stdlib HTTP on the
+existing keep-alive ``InferenceClient`` stack.
+
+The router owns four things:
+
+- **An active health model per replica.** Periodic ``GET /healthz`` probes
+  plus passive signals from real traffic (connect errors, timeouts, 5xx,
+  deadline misses) drive a per-replica state machine::
+
+      healthy → suspect → ejected → recovering → healthy
+                   ↑___________________|  (failure while recovering
+                                           re-ejects with doubled backoff)
+
+  Ejected replicas are re-probed on an exponential backoff; a successful
+  probe re-admits them as ``recovering`` (routable), and the first real
+  success heals them. A replica reporting ``draining`` is pulled without
+  ejection penalty; ``degraded`` (e.g. ``decode_saturated``) de-prioritizes
+  it in selection so prefill-heavy work steers to replicas with headroom.
+
+- **Failover with a shared retry budget.** A failed attempt fails over to
+  a different replica only while the token-bucket budget (deposits are a
+  fraction of live request volume) has balance — once it is spent the
+  client gets a FAST 503 ``retry_budget_exhausted`` instead of a retry
+  storm amplifying the brownout. Hedges spend the same budget.
+
+- **Hedged ``/predict``.** If the primary attempt hasn't answered after a
+  p95-based delay, a second copy goes to another replica; the first answer
+  wins and the loser is cancelled best-effort (its socket is closed and
+  the late result discarded).
+
+- **Least-outstanding-requests balancing** with per-tenant quotas
+  (``x-tenant`` header) and priority shedding (``x-priority``:
+  low|normal|high — low sheds first as the router fills) layered on the
+  replicas' existing deadline/429 machinery.
+
+Zero-downtime deploys ride ``rolling_restart()``: one replica at a time is
+administratively drained (its own graceful ``stop()`` flushes in-flight
+work), restarted by the caller, and re-admitted only after ``/healthz``
+reports ok AND a warmup probe has recompiled its bucket ladder.
+
+Topology, tuning knobs and the runbook live in docs/SERVING_TIER.md.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence
+from urllib.parse import urlparse
+
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.serving.client import InferenceClient
+
+__all__ = ["Router", "RetryBudget", "ReplicaState"]
+
+
+class ReplicaState:
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    EJECTED = "ejected"
+    RECOVERING = "recovering"
+
+
+# numeric encoding for the per-replica state gauge (alerting rules compare
+# against these; admin_down is reported on top via its own gauge)
+_STATE_VALUE = {ReplicaState.HEALTHY: 0, ReplicaState.SUSPECT: 1,
+                ReplicaState.EJECTED: 2, ReplicaState.RECOVERING: 3}
+
+# upstream statuses that mean "this replica failed the request" — eligible
+# for failover to a different replica. 504 is NOT here: the request's own
+# deadline is spent, retrying delivers a late answer nobody awaits.
+_FAILOVER_STATUSES = (429, 500, 502, 503)
+
+
+class RetryBudget:
+    """Token bucket bounding retries+hedges to a fraction of live traffic.
+
+    Every incoming request deposits ``ratio`` tokens (capped at ``cap``);
+    every failover attempt or hedge withdraws one. Under a full brownout
+    the budget drains in ~``initial`` retries and then refills at
+    ``ratio`` per request — so retry load is at most ``ratio`` of offered
+    load in steady state, which is what keeps a brownout from becoming a
+    self-inflicted storm."""
+
+    def __init__(self, ratio: float = 0.1, initial: float = 5.0,
+                 cap: float = 20.0):
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._balance = min(float(initial), self.cap)
+        self._lock = threading.Lock()
+        reg = get_registry()
+        self._m_spent = reg.counter(
+            "dl4jtpu_router_retry_budget_spent_total",
+            "Failover/hedge attempts paid for from the shared retry "
+            "budget.")
+        self._m_denied = reg.counter(
+            "dl4jtpu_router_retry_budget_denied_total",
+            "Failover/hedge attempts refused because the retry budget was "
+            "spent (the request then fails fast instead of retrying).")
+        reg.gauge(
+            "dl4jtpu_router_retry_budget_balance",
+            "Current retry-budget token balance.").set_function(
+                lambda: self._balance)
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._balance = min(self.cap, self._balance + self.ratio)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._balance >= 1.0:
+                self._balance -= 1.0
+                spent = True
+            else:
+                spent = False
+        (self._m_spent if spent else self._m_denied).inc()
+        return spent
+
+    @property
+    def balance(self) -> float:
+        return self._balance
+
+
+class _Replica:
+    """Router-side record for one upstream: health state + live counters."""
+
+    def __init__(self, url: str, timeout: float):
+        self.url = url.rstrip("/")
+        # retries=1: the router owns failover — the client must surface
+        # every upstream failure instead of retrying it in place
+        self.client = InferenceClient(self.url, timeout=timeout, retries=1)
+        self.probe_client = InferenceClient(self.url,
+                                            timeout=min(timeout, 5.0),
+                                            retries=1)
+        self.state = ReplicaState.HEALTHY
+        self.consecutive_failures = 0
+        self.outstanding = 0
+        self.degraded = False
+        self.draining = False
+        self.admin_down = False            # rolling restart holds this
+        self.ejected_until = 0.0
+        self.backoff = 0.0
+        self.lock = threading.Lock()
+
+    def routable(self) -> bool:
+        return (self.state != ReplicaState.EJECTED
+                and not self.admin_down and not self.draining)
+
+
+class _Attempt:
+    """One upstream try of one request (primary, failover, or hedge)."""
+
+    __slots__ = ("replica", "rid", "cancelled", "conn")
+
+    def __init__(self, replica: _Replica, rid: str):
+        self.replica = replica
+        self.rid = rid
+        self.cancelled = threading.Event()
+        self.conn = None
+
+    def cancel(self):
+        """Best-effort: close the in-flight socket so the losing half of a
+        hedged pair stops consuming its replica, and flag the attempt so
+        the resulting socket error is discarded instead of counting as a
+        passive failure (we caused it)."""
+        self.cancelled.set()
+        conn = self.conn
+        if conn is not None and conn.sock is not None:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def _reply(self, status: int, body: bytes, rid: Optional[str] = None):
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if rid:
+            self.send_header("x-request-id", rid)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        router = self.server.router
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            info = router.health_info()
+            self._reply(503 if info["status"] == "draining" else 200,
+                        json.dumps(info).encode())
+        elif path == "/stats":
+            self._reply(200, json.dumps(router.stats()).encode())
+        elif path == "/metrics":
+            data = get_registry().render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        else:
+            self._reply(404, json.dumps(
+                {"error": {"type": "not_found",
+                           "message": f"no such path: {path}"}}).encode())
+
+    def do_POST(self):
+        router = self.server.router
+        path = urlparse(self.path).path
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if path not in ("/predict", "/generate", "/warmup"):
+            self._reply(404, json.dumps(
+                {"error": {"type": "not_found",
+                           "message": f"no such path: {path}"}}).encode())
+            return
+        status, out, rid = router.handle(
+            path, body,
+            tenant=self.headers.get("x-tenant", "default"),
+            priority=self.headers.get("x-priority", "normal"),
+            request_id=self.headers.get("x-request-id"))
+        self._reply(status, out, rid)
+
+
+class Router:
+    """HTTP failover router over N replica InferenceServers.
+
+        router = Router(["http://127.0.0.1:9301", ...], port=0).start()
+        out = InferenceClient(f"http://127.0.0.1:{router.port}").predict(x)
+
+    Health/hedging/budget knobs are documented in docs/SERVING_TIER.md.
+    ``clock``/``sleep`` are injectable for the health model ONLY (probe
+    cadence, ejection backoff) so tests drive state transitions without
+    real waiting; the request path uses wall time.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, upstreams: Sequence[str], port: int = 0,
+                 host: str = "127.0.0.1",
+                 probe_interval: float = 1.0,
+                 eject_after: int = 3,
+                 probe_backoff_base: float = 0.5,
+                 probe_backoff_max: float = 30.0,
+                 retry_budget: Optional[RetryBudget] = None,
+                 hedge: bool = True,
+                 hedge_delay_ms: Optional[float] = None,
+                 hedge_floor_ms: float = 10.0,
+                 default_deadline_ms: Optional[float] = None,
+                 upstream_timeout: float = 30.0,
+                 tenant_quota: Optional[int] = None,
+                 max_outstanding: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        if not upstreams:
+            raise ValueError("router needs at least one upstream replica")
+        self.id = f"router{next(Router._ids)}"
+        self._clock = clock
+        self._sleep = sleep
+        # None disables the probe thread: tests with fake clocks call
+        # probe_once() by hand instead of racing a background sweep
+        self.probe_interval = (None if probe_interval is None
+                               else float(probe_interval))
+        self.eject_after = int(eject_after)
+        self.probe_backoff_base = float(probe_backoff_base)
+        self.probe_backoff_max = float(probe_backoff_max)
+        self.budget = retry_budget or RetryBudget()
+        self.hedge_enabled = bool(hedge)
+        self.hedge_delay_ms = hedge_delay_ms
+        self.hedge_floor_ms = float(hedge_floor_ms)
+        self.default_deadline_ms = default_deadline_ms
+        self.upstream_timeout = float(upstream_timeout)
+        self.tenant_quota = tenant_quota
+        self.max_outstanding = max_outstanding
+        self._replicas: Dict[str, _Replica] = {}
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        self._rid_counter = itertools.count(1)
+        self._rid_prefix = f"{os.getpid():x}"
+        self._tenant_outstanding: Dict[str, int] = {}
+        self._total_outstanding = 0
+        self._pool = ThreadPoolExecutor(max_workers=32,
+                                        thread_name_prefix=self.id)
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._httpd = None
+        self.port: Optional[int] = None
+        self._host = host
+        self._port_req = port
+
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "dl4jtpu_router_requests_total",
+            "Requests handled by the router. outcome: ok | failed_over "
+            "(ok after ≥1 failover) | hedge_win | shed | error.",
+            ("router", "path", "outcome"))
+        self._m_attempts = reg.counter(
+            "dl4jtpu_router_upstream_attempts_total",
+            "Individual upstream tries (primary + failover + hedge).",
+            ("router", "replica"))
+        self._m_failures = reg.counter(
+            "dl4jtpu_router_upstream_failures_total",
+            "Passive failure signals per replica. kind: connect | timeout "
+            "| 5xx | overloaded | draining | deadline_miss | probe.",
+            ("router", "replica", "kind"))
+        self._m_ejections = reg.counter(
+            "dl4jtpu_router_ejections_total",
+            "Replica ejections (consecutive passive failures crossed the "
+            "threshold, or a recovering replica failed again).",
+            ("router", "replica"))
+        self._m_readmissions = reg.counter(
+            "dl4jtpu_router_readmissions_total",
+            "Replicas re-admitted to rotation: a probe succeeded after "
+            "ejection, or a rolling restart completed its health gate.",
+            ("router", "replica"))
+        self._m_hedges = reg.counter(
+            "dl4jtpu_router_hedges_total",
+            "Hedged /predict attempts. outcome: fired | won (hedge beat "
+            "the primary) | cancelled (primary won, hedge discarded).",
+            ("router", "outcome"))
+        self._m_sheds = reg.counter(
+            "dl4jtpu_router_sheds_total",
+            "Requests shed at the router before any upstream attempt. "
+            "reason: tenant_quota | priority | no_replicas | deadline.",
+            ("router", "reason"))
+        self._m_probes = reg.counter(
+            "dl4jtpu_router_probes_total",
+            "Active /healthz probes. result: ok | degraded | draining | "
+            "error.", ("router", "replica", "result"))
+        self._m_latency = reg.histogram(
+            "dl4jtpu_router_upstream_latency_seconds",
+            "Latency of successful upstream attempts (feeds the p95 hedge "
+            "delay).", ("router", "path"))
+        self._m_state = reg.gauge(
+            "dl4jtpu_router_replica_state",
+            "Replica health state: 0 healthy, 1 suspect, 2 ejected, "
+            "3 recovering.", ("router", "replica"))
+        self._m_admin = reg.gauge(
+            "dl4jtpu_router_replica_admin_down",
+            "1 while a replica is administratively held out of rotation "
+            "(rolling restart).", ("router", "replica"))
+        self._m_outstanding = reg.gauge(
+            "dl4jtpu_router_replica_outstanding",
+            "In-flight upstream requests per replica (the "
+            "least-outstanding balancing signal).", ("router", "replica"))
+        for url in upstreams:
+            self._add_replica(url)
+
+    # ----------------------------------------------------------- replica set
+    def _add_replica(self, url: str) -> None:
+        rep = _Replica(url, timeout=self.upstream_timeout)
+        self._replicas[rep.url] = rep
+        lab = {"router": self.id, "replica": rep.url}
+        self._m_state.labels(**lab).set_function(
+            lambda r=rep: _STATE_VALUE[r.state])
+        self._m_admin.labels(**lab).set_function(
+            lambda r=rep: 1.0 if r.admin_down else 0.0)
+        self._m_outstanding.labels(**lab).set_function(
+            lambda r=rep: float(r.outstanding))
+
+    @property
+    def replicas(self) -> Dict[str, _Replica]:
+        return self._replicas
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "Router":
+        self._stop.clear()
+        if self.probe_interval is not None and (
+                self._probe_thread is None
+                or not self._probe_thread.is_alive()):
+            self._probe_thread = threading.Thread(target=self._probe_loop,
+                                                  daemon=True)
+            self._probe_thread.start()
+        self._httpd = ThreadingHTTPServer((self._host, self._port_req),
+                                          _RouterHandler)
+        self._httpd.router = self
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self._pool.shutdown(wait=False)
+
+    # ---------------------------------------------------------- health model
+    def _note_failure(self, rep: _Replica, kind: str) -> None:
+        self._m_failures.labels(router=self.id, replica=rep.url,
+                                kind=kind).inc()
+        if kind == "draining":
+            # the replica asked to be pulled — no ejection penalty, the
+            # probe loop re-admits it the moment /healthz stops draining
+            rep.draining = True
+            return
+        with rep.lock:
+            rep.consecutive_failures += 1
+            if rep.state == ReplicaState.RECOVERING:
+                self._eject_locked(rep)          # relapse: doubled backoff
+            elif rep.state == ReplicaState.HEALTHY:
+                rep.state = ReplicaState.SUSPECT
+            if (rep.state == ReplicaState.SUSPECT
+                    and rep.consecutive_failures >= self.eject_after):
+                self._eject_locked(rep)
+
+    def _eject_locked(self, rep: _Replica) -> None:
+        rep.state = ReplicaState.EJECTED
+        rep.backoff = min(self.probe_backoff_max,
+                          max(self.probe_backoff_base, rep.backoff * 2.0))
+        rep.ejected_until = self._clock() + rep.backoff
+        self._m_ejections.labels(router=self.id, replica=rep.url).inc()
+
+    def _note_success(self, rep: _Replica) -> None:
+        with rep.lock:
+            rep.consecutive_failures = 0
+            rep.draining = False
+            if rep.state != ReplicaState.HEALTHY:
+                # a real request succeeded — stronger evidence than any
+                # probe, so it heals even an ejected replica (the panic
+                # path below can route to one)
+                rep.state = ReplicaState.HEALTHY
+                rep.backoff = 0.0
+
+    def probe_once(self) -> None:
+        """One active probe sweep (the loop calls this every
+        ``probe_interval``; tests call it directly under a fake clock)."""
+        for rep in list(self._replicas.values()):
+            if rep.admin_down:
+                continue
+            if (rep.state == ReplicaState.EJECTED
+                    and self._clock() < rep.ejected_until):
+                continue                         # still backing off
+            try:
+                info = rep.probe_client.health()
+            except Exception:   # noqa: BLE001 — dead replica: any error
+                self._m_probes.labels(router=self.id, replica=rep.url,
+                                      result="error").inc()
+                self._note_failure(rep, "probe")
+                continue
+            status = info.get("status")
+            self._m_probes.labels(router=self.id, replica=rep.url,
+                                  result=status or "error").inc()
+            if status == "draining":
+                rep.draining = True
+                continue
+            rep.draining = False
+            rep.degraded = (status == "degraded")
+            if status in ("ok", "degraded"):
+                with rep.lock:
+                    if rep.state == ReplicaState.EJECTED:
+                        # re-admit provisionally; the first real success
+                        # (or the next probe-sweep success) heals it fully
+                        rep.state = ReplicaState.RECOVERING
+                        rep.consecutive_failures = 0
+                        self._m_readmissions.labels(
+                            router=self.id, replica=rep.url).inc()
+                    elif rep.state == ReplicaState.RECOVERING:
+                        rep.state = ReplicaState.HEALTHY
+                        rep.backoff = 0.0
+            else:
+                self._note_failure(rep, "probe")
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:   # noqa: BLE001 — probes must never die
+                pass
+            self._sleep(self.probe_interval)
+
+    # -------------------------------------------------------------- selection
+    def _pick(self, exclude) -> Optional[_Replica]:
+        with self._lock:
+            cands = [r for r in self._replicas.values()
+                     if r.routable() and r.url not in exclude]
+            if not cands:
+                # panic routing (fail-open): when the health model has
+                # ejected every replica, sending traffic to a maybe-dead
+                # one beats a certain 503 — and a success heals it
+                cands = [r for r in self._replicas.values()
+                         if not r.admin_down and r.url not in exclude]
+            if not cands:
+                return None
+            # degraded replicas (decode_saturated, queue_pressure) only
+            # take traffic when every healthy one is excluded/ejected
+            fresh = [r for r in cands if not r.degraded]
+            pool = fresh or cands
+            least = min(r.outstanding for r in pool)
+            best = [r for r in pool if r.outstanding == least]
+            return best[next(self._rr) % len(best)]   # round-robin the tie
+
+    # -------------------------------------------------------------- requests
+    def _mint_rid(self, supplied: Optional[str]) -> str:
+        if supplied:
+            return supplied
+        return f"req-{self._rid_prefix}-{next(self._rid_counter):06d}"
+
+    def _err(self, status: int, err_type: str, message: str, rid: str):
+        return status, json.dumps(
+            {"error": {"type": err_type, "message": message,
+                       "request_id": rid}}).encode(), rid
+
+    def _hedge_delay_s(self) -> float:
+        if self.hedge_delay_ms is not None:
+            return self.hedge_delay_ms / 1000.0
+        hist = self._m_latency.labels(router=self.id, path="/predict")
+        p95 = hist.percentile(0.95) if hist.count >= 20 else None
+        floor = self.hedge_floor_ms / 1000.0
+        return max(floor, p95) if p95 is not None else floor
+
+    def _admit(self, tenant: str, priority: str, rid: str):
+        """Quota + priority gate. Returns an error triple to send, or None
+        to admit (caller must _release)."""
+        with self._lock:
+            if self.max_outstanding is not None:
+                # priority shedding: low gives up headroom first, high may
+                # ride into the overflow band — all before any quota math
+                n = self._total_outstanding
+                cap = self.max_outstanding
+                limit = {"low": 0.75 * cap, "high": 1.5 * cap}.get(
+                    priority, float(cap))
+                if n >= limit:
+                    self._m_sheds.labels(router=self.id,
+                                         reason="priority").inc()
+                    return self._err(
+                        429, "overloaded",
+                        f"router at capacity ({n} outstanding); "
+                        f"{priority}-priority load shed", rid)
+            if self.tenant_quota is not None:
+                if self._tenant_outstanding.get(tenant, 0) \
+                        >= self.tenant_quota:
+                    self._m_sheds.labels(router=self.id,
+                                         reason="tenant_quota").inc()
+                    return self._err(
+                        429, "tenant_quota",
+                        f"tenant {tenant!r} at quota "
+                        f"({self.tenant_quota} outstanding)", rid)
+            self._tenant_outstanding[tenant] = \
+                self._tenant_outstanding.get(tenant, 0) + 1
+            self._total_outstanding += 1
+        return None
+
+    def _release(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant_outstanding[tenant] = max(
+                0, self._tenant_outstanding.get(tenant, 1) - 1)
+            self._total_outstanding = max(0, self._total_outstanding - 1)
+
+    def handle(self, path: str, body: bytes, tenant: str = "default",
+               priority: str = "normal",
+               request_id: Optional[str] = None):
+        """Route one request; returns ``(status, body_bytes, request_id)``.
+        Exposed directly (not just via HTTP) so tests can drive the router
+        without sockets where sockets add nothing."""
+        rid = self._mint_rid(request_id)
+        self.budget.deposit()
+        shed = self._admit(tenant, priority, rid)
+        if shed is not None:
+            return shed
+        try:
+            expires = self._expiry(body)
+            hedge = self.hedge_enabled and path == "/predict"
+            return self._forward(path, body, rid, expires, hedge)
+        finally:
+            self._release(tenant)
+
+    def _expiry(self, body: bytes) -> Optional[float]:
+        deadline_ms = self.default_deadline_ms
+        try:
+            payload = json.loads(body.decode())
+            if isinstance(payload, dict) and "deadline_ms" in payload:
+                deadline_ms = float(payload["deadline_ms"])
+        except Exception:   # noqa: BLE001 — replicas answer 400 for junk
+            pass
+        if deadline_ms is None:
+            return None
+        return time.perf_counter() + deadline_ms / 1000.0
+
+    # ------------------------------------------------------------ forwarding
+    def _run_attempt(self, att: _Attempt, path: str, body: bytes,
+                     results: "queue.Queue") -> None:
+        rep = att.replica
+        with rep.lock:
+            rep.outstanding += 1
+        self._m_attempts.labels(router=self.id, replica=rep.url).inc()
+        t0 = time.perf_counter()
+        try:
+            att.conn = rep.client._conn()
+            status, data, _hdrs = rep.client.post_raw(
+                path, body, headers={"x-request-id": att.rid},
+                give_up=att.cancelled.is_set)
+            results.put((att, status, data, None,
+                         time.perf_counter() - t0))
+        except Exception as e:  # noqa: BLE001 — classified by the waiter
+            results.put((att, None, None, e, time.perf_counter() - t0))
+        finally:
+            with rep.lock:
+                rep.outstanding -= 1
+
+    def _classify_failure(self, status, exc) -> str:
+        if exc is not None:
+            if isinstance(exc, TimeoutError):
+                return "timeout"
+            return "connect"
+        if status == 429:
+            return "overloaded"
+        if status == 503:
+            return "draining"
+        return "5xx"
+
+    def _forward(self, path: str, body: bytes, rid: str,
+                 expires: Optional[float], hedge: bool):
+        results: "queue.Queue" = queue.Queue()
+        live: List[_Attempt] = []
+        tried = set()
+        n_attempt = itertools.count()
+
+        def launch(rep: _Replica) -> None:
+            att = _Attempt(rep, f"{rid}#a{next(n_attempt)}")
+            tried.add(rep.url)
+            live.append(att)
+            self._pool.submit(self._run_attempt, att, path, body, results)
+
+        def outcome(tag: str):
+            self._m_requests.labels(router=self.id, path=path,
+                                    outcome=tag).inc()
+
+        primary = self._pick(tried)
+        if primary is None:
+            outcome("shed")
+            self._m_sheds.labels(router=self.id, reason="no_replicas").inc()
+            return self._err(503, "no_healthy_replicas",
+                             "no routable replica", rid)
+        launch(primary)
+        hedge_at = (time.perf_counter() + self._hedge_delay_s()
+                    if hedge else None)
+        failed_over = False
+        hedged = False
+
+        while True:
+            now = time.perf_counter()
+            if expires is not None and now >= expires:
+                for att in live:
+                    att.cancel()
+                outcome("error")
+                self._m_sheds.labels(router=self.id, reason="deadline").inc()
+                return self._err(504, "deadline_exceeded",
+                                 "request deadline expired at the router",
+                                 rid)
+            timeout = None
+            if expires is not None:
+                timeout = expires - now
+            if hedge_at is not None:
+                timeout = min(timeout, hedge_at - now) \
+                    if timeout is not None else hedge_at - now
+            try:
+                att, status, data, exc, dt = results.get(
+                    timeout=max(0.001, timeout) if timeout is not None
+                    else None)
+            except queue.Empty:
+                if hedge_at is not None and time.perf_counter() >= hedge_at:
+                    hedge_at = None
+                    rep2 = self._pick(tried)
+                    if rep2 is not None and self.budget.try_spend():
+                        hedged = True
+                        self._m_hedges.labels(router=self.id,
+                                              outcome="fired").inc()
+                        launch(rep2)
+                continue
+
+            live.remove(att)
+            if att.cancelled.is_set():
+                continue                    # the loser we cancelled
+            rep = att.replica
+            is_failure = (exc is not None or status is None
+                          or status in _FAILOVER_STATUSES)
+            if not is_failure:
+                if status == 504:
+                    # the replica spent the request's deadline: passive
+                    # signal, but the answer goes back as-is (no retry)
+                    self._note_failure(rep, "deadline_miss")
+                else:
+                    self._note_success(rep)
+                    self._m_latency.labels(router=self.id,
+                                           path=path).observe(dt)
+                for other in live:
+                    other.cancel()
+                    self._m_hedges.labels(router=self.id,
+                                          outcome="cancelled").inc()
+                if hedged and not att.rid.endswith("#a0"):
+                    self._m_hedges.labels(router=self.id,
+                                          outcome="won").inc()
+                    outcome("hedge_win")
+                elif failed_over:
+                    outcome("failed_over")
+                else:
+                    outcome("ok")
+                return status, data, rid
+
+            self._note_failure(rep, self._classify_failure(status, exc))
+            if live:
+                continue                    # a sibling attempt may still win
+            if expires is not None and time.perf_counter() >= expires:
+                continue                    # top of loop answers 504
+            nxt = self._pick(tried)
+            if nxt is None:
+                outcome("error")
+                return self._err(
+                    502, "upstream_failed",
+                    "every routable replica failed this request "
+                    f"(last: {exc or status})", rid)
+            if not self.budget.try_spend():
+                outcome("error")
+                return self._err(
+                    503, "retry_budget_exhausted",
+                    "upstream failed and the shared retry budget is "
+                    "spent; failing fast instead of retrying", rid)
+            failed_over = True
+            launch(nxt)
+
+    # -------------------------------------------------------- rolling restart
+    def rolling_restart(self, restarter: Callable[[str], None],
+                        drain_timeout: float = 30.0,
+                        ready_timeout: float = 180.0,
+                        warmup_shape=None,
+                        warmup_max_batch: Optional[int] = None) -> None:
+        """Zero-downtime deploy: one replica at a time —
+
+        1. hold it out of rotation (``admin_down``; new traffic avoids it),
+        2. wait for its in-flight requests to finish,
+        3. ``restarter(url)`` stops + restarts the actual process (the
+           replica's own graceful ``stop()`` drains its queues),
+        4. re-admit only after ``/healthz`` answers ok AND (when
+           ``warmup_shape`` is given) a warmup probe recompiled its bucket
+           ladder — a replica is never handed traffic it would cold-compile
+           against.
+        """
+        for url, rep in list(self._replicas.items()):
+            rep.admin_down = True
+            try:
+                deadline = time.monotonic() + drain_timeout
+                while rep.outstanding > 0 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                restarter(url)
+                self._await_ready(rep, ready_timeout, warmup_shape,
+                                  warmup_max_batch)
+            finally:
+                rep.admin_down = False
+            with rep.lock:
+                rep.state = ReplicaState.HEALTHY
+                rep.consecutive_failures = 0
+                rep.backoff = 0.0
+                rep.draining = False
+            self._m_readmissions.labels(router=self.id, replica=url).inc()
+
+    def _await_ready(self, rep: _Replica, ready_timeout: float,
+                     warmup_shape, warmup_max_batch) -> None:
+        deadline = time.monotonic() + ready_timeout
+        while True:
+            try:
+                if rep.probe_client.health().get("status") == "ok":
+                    break
+            except Exception:   # noqa: BLE001 — still restarting
+                pass
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"replica {rep.url} did not become healthy within "
+                    f"{ready_timeout}s after restart")
+            time.sleep(0.05)
+        if warmup_shape is not None:
+            rep.client.close()      # the pre-restart socket is stale
+            rep.client.warmup(warmup_shape, max_batch=warmup_max_batch)
+
+    # ------------------------------------------------------------------ info
+    def health_info(self) -> dict:
+        states = {url: r.state for url, r in self._replicas.items()}
+        routable = sum(1 for r in self._replicas.values() if r.routable())
+        if self._stop.is_set():
+            return {"status": "draining"}
+        if routable == 0:
+            return {"status": "degraded", "reason": "no_routable_replicas"}
+        if routable < len(states):
+            return {"status": "degraded", "reason": "replicas_out"}
+        return {"status": "ok"}
+
+    def stats(self) -> dict:
+        reps = {}
+        for url, r in self._replicas.items():
+            reps[url] = {"state": r.state,
+                         "outstanding": r.outstanding,
+                         "consecutive_failures": r.consecutive_failures,
+                         "degraded": r.degraded,
+                         "draining": r.draining,
+                         "admin_down": r.admin_down,
+                         "probe_backoff_s": r.backoff}
+        return {"id": self.id,
+                "replicas": reps,
+                "retry_budget_balance": round(self.budget.balance, 3),
+                "hedge_delay_ms": round(self._hedge_delay_s() * 1e3, 2),
+                "total_outstanding": self._total_outstanding,
+                "tenants": dict(self._tenant_outstanding)}

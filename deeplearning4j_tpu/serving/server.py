@@ -26,6 +26,7 @@ load balancers pull the instance while in-flight work flushes).
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -36,14 +37,15 @@ import numpy as np
 
 from deeplearning4j_tpu.clustering.knn_server import (
     ndarray_from_b64, ndarray_to_b64)
-from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.monitor import get_registry, trace
 from deeplearning4j_tpu.resilience.errors import (
-    BatcherStoppedError, DeadlineExceededError, ServerOverloadedError)
+    BatcherStoppedError, DeadlineExceededError, InjectedFaultError,
+    ServerOverloadedError)
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 
 _KNOWN_PATHS = ("/predict", "/generate", "/warmup", "/stats", "/metrics",
-                "/healthz")
+                "/healthz", "/chaos")
 
 
 def _http_metrics():
@@ -70,22 +72,36 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
 
+    @property
+    def _rid(self):
+        # router-assigned x-request-id: echoed on every response and into
+        # error bodies + trace spans, so one grep follows a request across
+        # the router, both halves of a hedged pair, and the replica
+        return self.headers.get("x-request-id")
+
     def _json(self, obj, code=200):
         data = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        if self._rid:
+            self.send_header("x-request-id", self._rid)
         self.end_headers()
         self.wfile.write(data)
 
     def _error(self, code: int, err_type: str, message: str):
-        self._json({"error": {"type": err_type, "message": message}}, code)
+        err = {"type": err_type, "message": message}
+        if self._rid:
+            err["request_id"] = self._rid
+        self._json({"error": err}, code)
 
     def _text(self, body: str, content_type: str, code=200):
         data = body.encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        if self._rid:
+            self.send_header("x-request-id", self._rid)
         self.end_headers()
         self.wfile.write(data)
 
@@ -96,7 +112,9 @@ class _Handler(BaseHTTPRequestHandler):
         label = path if path in _KNOWN_PATHS else "other"
         t0 = time.perf_counter()
         try:
-            fn()
+            with trace.span("http_request", path=label,
+                            request_id=self._rid or ""):
+                fn()
         finally:
             counter.labels(path=label).inc()
             hist.labels(path=label).observe(time.perf_counter() - t0)
@@ -109,9 +127,9 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/stats":
                 self._json(srv.stats())
             elif path == "/healthz":
-                status = srv.health()
-                self._json({"status": status},
-                           503 if status == "draining" else 200)
+                info = srv.health_info()
+                self._json(info,
+                           503 if info["status"] == "draining" else 200)
             elif path == "/metrics":
                 self._text(get_registry().render(),
                            "text/plain; version=0.0.4; charset=utf-8")
@@ -134,10 +152,23 @@ class _Handler(BaseHTTPRequestHandler):
 
         def handle():
             try:
+                if path in ("/predict", "/generate") \
+                        and srv.fault_injector is not None:
+                    # chaos harness hook: injected latency rides the handler
+                    # thread; injected faults surface as the configured 5xx
+                    srv.fault_injector.maybe_inject(path)
                 if path == "/predict":
                     self._predict(srv, payload)
                 elif path == "/generate":
                     self._generate(srv, payload)
+                elif path == "/chaos":
+                    if srv.fault_injector is None:
+                        self._error(404, "not_found",
+                                    "chaos injection not enabled "
+                                    "on this server")
+                    else:
+                        srv.fault_injector.configure(**payload)
+                        self._json({"chaos": srv.fault_injector.describe()})
                 elif path == "/warmup":
                     try:
                         shape = payload["input_shape"]
@@ -155,6 +186,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._error(404, "not_found", f"no such path: {path}")
             except BadRequestError as e:
                 self._error(400, "bad_request", str(e))
+            except InjectedFaultError as e:
+                self._error(e.code, "injected_fault", str(e))
             except ServerOverloadedError as e:
                 self._error(429, "overloaded", str(e))
             except BatcherStoppedError as e:
@@ -237,11 +270,15 @@ class InferenceServer:
                  engine: Optional[InferenceEngine] = None,
                  max_queue: int = 1024,
                  request_timeout_ms: Optional[float] = None,
-                 decode_engine=None):
+                 decode_engine=None, fault_injector=None):
         self.engine = engine or InferenceEngine(model)
         # serving/decode.DecodeEngine for POST /generate (None = endpoint
         # answers 404; predict-only servers don't pay for decode slots)
         self.decode_engine = decode_engine
+        # resilience/faults.ServerFaultInjector (chaos harness): when set,
+        # /predict and /generate pass through it (latency / injected 5xx)
+        # and POST /chaos reconfigures it live; None = no chaos surface
+        self.fault_injector = fault_injector
         self.batcher = MicroBatcher(self.engine, max_batch=max_batch,
                                     max_latency_ms=max_latency_ms,
                                     max_queue=max_queue)
@@ -275,14 +312,24 @@ class InferenceServer:
                 f"input shape {tuple(x.shape)} does not match model input "
                 f"(batch, {', '.join(str(d) for d in expected[1:])})")
 
-    def health(self) -> str:
+    def health_info(self) -> dict:
+        """``{"status": ...}`` plus a ``reason`` when degraded. Degraded
+        states a router acts on: ``queue_pressure`` (micro-batch queue ≥80%
+        full) and ``decode_saturated`` (every DecodeEngine slot busy — new
+        /generate work queues behind a full batch, so prefill-heavy traffic
+        should steer to replicas with free slots)."""
         if self._draining.is_set() or self.batcher.stopping:
-            return "draining"
+            return {"status": "draining"}
         st = self.batcher.stats()
         if st["queue_capacity"] and (st["queue_depth"]
                                      >= 0.8 * st["queue_capacity"]):
-            return "degraded"
-        return "ok"
+            return {"status": "degraded", "reason": "queue_pressure"}
+        if self.decode_engine is not None and self.decode_engine.saturated:
+            return {"status": "degraded", "reason": "decode_saturated"}
+        return {"status": "ok"}
+
+    def health(self) -> str:
+        return self.health_info()["status"]
 
     def stats(self) -> dict:
         out = {"engine": self.engine.stats(),
@@ -298,7 +345,7 @@ class InferenceServer:
         self.batcher.start()
         if self.decode_engine is not None:
             self.decode_engine.start()
-        self._httpd = ThreadingHTTPServer((self._host, self._port_req),
+        self._httpd = _TrackingHTTPServer((self._host, self._port_req),
                                           _Handler)
         self._httpd.inference = self
         self.port = self._httpd.server_address[1]
@@ -309,7 +356,10 @@ class InferenceServer:
     def stop(self) -> None:
         """Graceful drain: flag draining (healthz → 503, LBs pull us), let
         the batcher flush everything already queued, then close the HTTP
-        listener. Requests arriving mid-drain get fast 503s, not hangs."""
+        listener AND every established keep-alive connection. Requests
+        arriving mid-drain get fast 503s, not hangs — and clients are
+        forced to redial, so a restart-in-place on the same port never
+        leaves them talking to the dead server's handler threads."""
         self._draining.set()
         self.batcher.stop()
         if self.decode_engine is not None:
@@ -317,3 +367,44 @@ class InferenceServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+            self._httpd.close_all_connections()
+
+
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that remembers established connections.
+
+    ``shutdown()`` only stops the accept loop; keep-alive connections
+    stay open and their daemon handler threads keep answering — after a
+    graceful stop that means a permanent stream of 503s on sockets a
+    freshly restarted server on the same port can never inherit. Closing
+    them at stop() turns "stale connection" into a connect-level error
+    the client's reconnect-once logic absorbs on its next request."""
+
+    def __init__(self, *args, **kwargs):
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def get_request(self):
+        sock_, addr = super().get_request()
+        with self._conns_lock:
+            self._conns.add(sock_)
+        return sock_, addr
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for sock_ in conns:
+            try:
+                sock_.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock_.close()
+            except OSError:
+                pass

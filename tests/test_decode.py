@@ -330,3 +330,32 @@ def test_decode_soak_many_requests_one_program():
         assert eng.stats()["requests"] == 64
     finally:
         eng.stop()
+
+
+def test_healthz_degraded_while_decode_slots_saturated():
+    """Satellite: a server whose DecodeEngine has every slot busy must
+    report ``degraded`` (reason decode_saturated) on /healthz — routers
+    steer prefill-heavy traffic away from it — and return to ``ok`` once
+    slots free up."""
+    net = _lstm_net()
+    dec = DecodeEngine(net, slots=1, max_len=24)
+    srv = InferenceServer(net, port=0, decode_engine=dec).start()
+    try:
+        cli = InferenceClient(f"http://127.0.0.1:{srv.port}")
+        assert cli.health() == {"status": "ok"}
+        futs = [dec.submit([1, 2], max_new_tokens=20) for _ in range(6)]
+        saw = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            h = cli.health()
+            if h.get("status") == "degraded":
+                saw = h
+                break
+            time.sleep(0.001)
+        assert saw == {"status": "degraded", "reason": "decode_saturated"}
+        assert dec.saturated
+        for f in futs:
+            f.result(timeout=120)
+        assert cli.health() == {"status": "ok"}
+    finally:
+        srv.stop()

@@ -1,0 +1,430 @@
+"""Replicated serving tier (serving/router.py + serving/replica.py).
+
+The load-bearing claims pinned here:
+- the router balances /predict over replicas with BITWISE parity to a
+  direct replica call, and stamps every response with an x-request-id;
+- a replica answering 5xx is failed over transparently, then ejected
+  after consecutive failures (healthy → suspect → ejected), with the
+  ejection and the failover both visible in /metrics;
+- the shared retry budget bounds failover: once spent, the client gets a
+  FAST 503 ``retry_budget_exhausted`` instead of a retry storm — over
+  real sockets, with a fake clock keeping the health model frozen;
+- an ejected replica is re-admitted through backoff-spaced probes
+  (ejected → recovering → healthy), driven deterministically by a fake
+  clock;
+- a hedged /predict sends a second copy after the hedge delay and the
+  first answer wins (hedges fired AND won observed);
+- per-tenant quotas and priority shedding answer 429 at the router
+  before any upstream attempt;
+- a rolling restart under live traffic completes with ZERO failed
+  requests (drain → restart → health-gate → re-admit);
+- (slow) the chaos soak: 3 subprocess replicas under a mixed
+  /predict+/generate storm, one SIGKILLed and one rolling-restarted
+  mid-storm — zero failed in-deadline requests, ejection + failover +
+  re-admission all observed via /metrics.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.serving import (InferenceClient, InProcessReplica,
+                                        ReplicaProcess, RetryBudget, Router)
+
+
+class _FakeTime:
+    """Injectable clock+sleeper for the router's HEALTH model: probe
+    cadence and ejection backoff advance without real waiting."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _counter_value(name, **labels):
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    want = tuple(str(labels[k]) for k in fam.labelnames)
+    for key, child in fam.children():
+        if key == want:
+            return child.value
+    return 0.0
+
+
+def _mk_tier(n=2, model="mlp", **router_kw):
+    reps = [InProcessReplica(model=model).start() for _ in range(n)]
+    router_kw.setdefault("probe_interval", None)
+    router = Router([r.url for r in reps], port=0, **router_kw).start()
+    cli = InferenceClient(f"http://127.0.0.1:{router.port}")
+    return reps, router, cli
+
+
+def _teardown(reps, router, cli):
+    cli.close()
+    router.stop()
+    for r in reps:
+        r.stop()
+
+
+def _set_chaos(rep, **cfg):
+    """Reconfigure a replica's fault injector over its own /chaos endpoint
+    (the same remote surface the subprocess soak uses)."""
+    c = InferenceClient(rep.url, retries=1)
+    try:
+        st, body, _ = c.post_raw("/chaos", json.dumps(cfg).encode())
+        assert st == 200, body
+    finally:
+        c.close()
+
+
+X = np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0
+
+
+# ------------------------------------------------------- routing + request ids
+
+def test_router_balances_with_parity_and_request_ids():
+    reps, router, cli = _mk_tier(n=2)
+    try:
+        direct = InferenceClient(reps[0].url)
+        want = direct.predict(X)
+        direct.close()
+        for _ in range(6):
+            out = cli.predict(X)
+            assert np.array_equal(out, want)      # replicas share the seed
+        # both replicas actually served (least-outstanding + round-robin)
+        for r in reps:
+            assert _counter_value("dl4jtpu_router_upstream_attempts_total",
+                                  router=router.id, replica=r.url) > 0
+        # x-request-id: minted by the router, echoed by the replica
+        st, body, hdrs = cli.post_raw(
+            "/predict", json.dumps({"ndarray": None}).encode())
+        assert st == 400                          # replica-side validation
+        rid = hdrs.get("x-request-id")
+        assert rid and rid.startswith("req-")
+        assert json.loads(body)["error"]["request_id"].startswith(rid)
+        # a caller-supplied id is preserved end to end
+        st, body, hdrs = cli.post_raw(
+            "/predict", json.dumps({"ndarray": None}).encode(),
+            headers={"x-request-id": "trace-me-7"})
+        assert hdrs.get("x-request-id") == "trace-me-7"
+    finally:
+        _teardown(reps, router, cli)
+
+
+# ------------------------------------------------------------------- failover
+
+def test_failover_on_replica_5xx_then_ejection():
+    reps, router, cli = _mk_tier(n=2, hedge=False)
+    try:
+        _set_chaos(reps[0], fail_next=100)        # replica 0 browns out
+        for _ in range(8):
+            out = cli.predict(X)                  # every request still served
+            assert out.shape == (3, 3)
+        states = {u: r["state"]
+                  for u, r in cli.stats()["replicas"].items()}
+        assert states[reps[0].url] == "ejected"
+        assert states[reps[1].url] == "healthy"
+        assert _counter_value("dl4jtpu_router_ejections_total",
+                              router=router.id, replica=reps[0].url) >= 1
+        assert _counter_value("dl4jtpu_router_upstream_failures_total",
+                              router=router.id, replica=reps[0].url,
+                              kind="5xx") >= 1
+        # once ejected, traffic stops reaching replica 0 entirely
+        before = _counter_value("dl4jtpu_router_upstream_attempts_total",
+                                router=router.id, replica=reps[0].url)
+        for _ in range(4):
+            cli.predict(X)
+        after = _counter_value("dl4jtpu_router_upstream_attempts_total",
+                               router=router.id, replica=reps[0].url)
+        assert after == before
+    finally:
+        _teardown(reps, router, cli)
+
+
+def test_retry_budget_exhaustion_fails_fast(  # satellite: budget semantics
+        ):
+    ft = _FakeTime()
+    reps, router, cli = _mk_tier(
+        n=2, hedge=False, clock=ft.clock, sleep=ft.sleep,
+        eject_after=1000,       # keep both replicas in rotation: every
+                                # request exercises failover, not ejection
+        retry_budget=RetryBudget(ratio=0.0, initial=2.0, cap=2.0))
+    try:
+        for r in reps:
+            _set_chaos(r, fail_next=1000)         # full brownout
+        # requests 1..2: failover runs (and also fails) — one token each
+        for _ in range(2):
+            st, body, _ = cli.post_raw(
+                "/predict", json.dumps({"ndarray": None}).encode())
+            assert st == 502
+            assert json.loads(body)["error"]["type"] == "upstream_failed"
+        assert router.budget.balance == 0.0
+        # request 3: budget spent → fast 503, exactly ONE upstream attempt
+        before = sum(_counter_value(
+            "dl4jtpu_router_upstream_attempts_total",
+            router=router.id, replica=r.url) for r in reps)
+        t0 = time.perf_counter()
+        st, body, _ = cli.post_raw(
+            "/predict", json.dumps({"ndarray": None}).encode())
+        elapsed = time.perf_counter() - t0
+        assert st == 503
+        assert json.loads(body)["error"]["type"] == "retry_budget_exhausted"
+        assert elapsed < 1.0                      # fast-fail, no backoff
+        after = sum(_counter_value(
+            "dl4jtpu_router_upstream_attempts_total",
+            router=router.id, replica=r.url) for r in reps)
+        assert after - before == 1
+        # healthy traffic replenishes the bucket: deposits resume failover
+        router.budget.ratio = 1.0
+        _set_chaos(reps[1], fail_next=0)
+        out = cli.predict(X)
+        assert out.shape == (3, 3)
+    finally:
+        _teardown(reps, router, cli)
+
+
+# ------------------------------------------------------- ejection → recovery
+
+def test_ejected_replica_recovers_through_probes():
+    ft = _FakeTime()
+    reps, router, cli = _mk_tier(n=2, hedge=False, eject_after=2,
+                                 clock=ft.clock, sleep=ft.sleep,
+                                 probe_backoff_base=4.0)
+    try:
+        rep0 = router.replicas[reps[0].url]
+        _set_chaos(reps[0], fail_next=1000)
+        for _ in range(6):
+            cli.predict(X)
+        assert rep0.state == "ejected"
+        # probe during backoff: skipped, replica stays out
+        router.probe_once()
+        assert rep0.state == "ejected"
+        # backoff expires but the replica is still sick: re-ejected with a
+        # DOUBLED backoff window
+        ft.t = rep0.ejected_until + 0.01
+        first_backoff = rep0.backoff
+        router.probe_once()       # healthz passes (chaos gates only the
+        assert rep0.state == "recovering"         # data paths) → provisional
+        for _ in range(2):        # round-robin guarantees rep0 gets traffic
+            cli.predict(X)                        # ...which still fails
+        assert rep0.state == "ejected"
+        assert rep0.backoff == 2 * first_backoff
+        # now it actually heals: probe re-admits, real success completes it
+        _set_chaos(reps[0], fail_next=0)
+        ft.t = rep0.ejected_until + 0.01
+        router.probe_once()
+        assert rep0.state == "recovering"
+        assert _counter_value("dl4jtpu_router_readmissions_total",
+                              router=router.id, replica=reps[0].url) >= 1
+        for _ in range(4):
+            cli.predict(X)
+        assert rep0.state == "healthy"
+        assert rep0.backoff == 0.0
+    finally:
+        _teardown(reps, router, cli)
+
+
+# -------------------------------------------------------------------- hedging
+
+def test_hedged_predict_first_answer_wins():
+    reps, router, cli = _mk_tier(n=2, hedge=True, hedge_delay_ms=40.0)
+    try:
+        direct = InferenceClient(reps[0].url)
+        want = direct.predict(X)
+        direct.close()
+        _set_chaos(reps[0], latency_ms=1500.0)    # one slow replica
+        t0 = time.perf_counter()
+        for _ in range(4):                        # round-robin: ~half the
+            out = cli.predict(X)                  # primaries land slow
+            assert np.array_equal(out, want)
+        elapsed = time.perf_counter() - t0
+        fired = _counter_value("dl4jtpu_router_hedges_total",
+                               router=router.id, outcome="fired")
+        won = _counter_value("dl4jtpu_router_hedges_total",
+                             router=router.id, outcome="won")
+        assert fired >= 1
+        assert won >= 1
+        # the hedge rescued the p99: nothing waited out the full 1.5s
+        assert elapsed < 0.5 * 1.5 * 4
+    finally:
+        _teardown(reps, router, cli)
+
+
+# ------------------------------------------------------------ quotas + sheds
+
+def test_tenant_quota_and_priority_shedding():
+    reps, router, cli = _mk_tier(n=1, hedge=False, tenant_quota=2,
+                                 max_outstanding=8)
+    try:
+        body = json.dumps({"ndarray": None}).encode()
+        # tenant at quota → 429 tenant_quota before any upstream attempt
+        router._tenant_outstanding["acme"] = 2
+        before = _counter_value("dl4jtpu_router_upstream_attempts_total",
+                                router=router.id, replica=reps[0].url)
+        st, out, _ = cli.post_raw("/predict", body,
+                                  headers={"x-tenant": "acme"})
+        assert st == 429
+        assert json.loads(out)["error"]["type"] == "tenant_quota"
+        assert _counter_value("dl4jtpu_router_upstream_attempts_total",
+                              router=router.id,
+                              replica=reps[0].url) == before
+        # other tenants are unaffected
+        st, _, _ = cli.post_raw("/predict", body,
+                                headers={"x-tenant": "other"})
+        assert st == 400                          # reached the replica
+        router._tenant_outstanding["acme"] = 0
+        # priority shedding: at capacity, low and normal shed, high rides
+        # the overflow band
+        router._total_outstanding = 8
+        st, out, _ = cli.post_raw("/predict", body,
+                                  headers={"x-priority": "low"})
+        assert st == 429
+        st, out, _ = cli.post_raw("/predict", body)
+        assert st == 429
+        assert json.loads(out)["error"]["type"] == "overloaded"
+        st, _, _ = cli.post_raw("/predict", body,
+                                headers={"x-priority": "high"})
+        assert st == 400                          # admitted → replica 400
+        router._total_outstanding = 0
+        assert _counter_value("dl4jtpu_router_sheds_total",
+                              router=router.id, reason="tenant_quota") >= 1
+        assert _counter_value("dl4jtpu_router_sheds_total",
+                              router=router.id, reason="priority") >= 2
+    finally:
+        _teardown(reps, router, cli)
+
+
+# ------------------------------------------------------------ rolling restart
+
+def test_rolling_restart_zero_downtime():
+    reps, router, cli = _mk_tier(n=2, hedge=False, probe_interval=0.2)
+    try:
+        by_url = {r.url: r for r in reps}
+        stop = threading.Event()
+        failures, served = [], [0]
+
+        def storm():
+            c = InferenceClient(f"http://127.0.0.1:{router.port}",
+                                retries=1)
+            while not stop.is_set():
+                try:
+                    c.predict(X)
+                    served[0] += 1
+                except Exception as e:   # noqa: BLE001 — any failure counts
+                    failures.append(repr(e))
+            c.close()
+
+        threads = [threading.Thread(target=storm) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            while served[0] < 5:                  # traffic is flowing
+                time.sleep(0.01)
+            router.rolling_restart(
+                lambda url: by_url[url].restart(),
+                warmup_shape=(4,), ready_timeout=60.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not failures, failures[:3]
+        assert served[0] > 10
+        states = {u: r["state"]
+                  for u, r in router.stats()["replicas"].items()}
+        assert all(s == "healthy" for s in states.values())
+        for r in reps:
+            assert _counter_value("dl4jtpu_router_readmissions_total",
+                                  router=router.id, replica=r.url) >= 1
+    finally:
+        _teardown(reps, router, cli)
+
+
+# ----------------------------------------------------------------- chaos soak
+
+@pytest.mark.slow
+def test_chaos_soak_kill_and_roll_replicas_mid_storm(tmp_path):
+    """3 subprocess replicas; mid-storm one is SIGKILLed (then restarted)
+    and another rolling-restarted. Every in-deadline request must succeed,
+    and /metrics must show ejection, failover, and re-admission."""
+    reps = [ReplicaProcess(str(tmp_path), model="charlstm",
+                           name=f"replica{i}").start()
+            for i in range(3)]
+    for r in reps:
+        r.wait_ready()
+    router = Router([r.url for r in reps], port=0, probe_interval=0.25,
+                    hedge=True, hedge_delay_ms=250.0,
+                    upstream_timeout=60.0).start()
+    base = f"http://127.0.0.1:{router.port}"
+    by_url = {r.url: r for r in reps}
+    stop = threading.Event()
+    failures, served = [], [0]
+    count_lock = threading.Lock()
+
+    def storm(seed):
+        rs = np.random.RandomState(seed)
+        c = InferenceClient(base, retries=1, timeout=60.0)
+        while not stop.is_set():
+            try:
+                if rs.rand() < 0.5:
+                    x = np.zeros((2, 6, 16), np.float32)
+                    x[:, np.arange(6), rs.randint(0, 16, 6)] = 1.0
+                    c.predict(x)
+                else:
+                    c.generate(rs.randint(0, 16, 3).tolist(),
+                               max_new_tokens=6, seed=int(seed))
+                with count_lock:
+                    served[0] += 1
+            except Exception as e:   # noqa: BLE001 — every failure counts:
+                failures.append(repr(e))   # the soak's claim is ZERO failed
+        c.close()
+
+    threads = [threading.Thread(target=storm, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        while served[0] < 20:                     # storm is established
+            time.sleep(0.05)
+        reps[0].kill()                            # crash: no drain, no FIN
+        while served[0] < 60:                     # tier absorbs the crash
+            time.sleep(0.05)
+        reps[0].start().wait_ready()              # ...and the replacement
+        router.rolling_restart(                   # roll another mid-storm
+            lambda url: (by_url[url].stop(), by_url[url].start(),
+                         by_url[url].wait_ready()),
+            warmup_shape=None, ready_timeout=120.0)
+        while served[0] < 100:
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    try:
+        assert not failures, failures[:5]
+        assert served[0] >= 100
+        import urllib.request
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+
+        def total(name):
+            return sum(float(line.rsplit(" ", 1)[1])
+                       for line in text.splitlines()
+                       if line.startswith(name + "{"))
+        assert total("dl4jtpu_router_ejections_total") >= 1
+        assert total("dl4jtpu_router_readmissions_total") >= 1
+        assert total("dl4jtpu_router_upstream_failures_total") >= 1
+        states = {u: r["state"]
+                  for u, r in router.stats()["replicas"].items()}
+        assert all(s == "healthy" for s in states.values()), states
+    finally:
+        router.stop()
+        for r in reps:
+            r.stop()

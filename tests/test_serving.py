@@ -357,3 +357,57 @@ def test_warmed_server_serves_first_predict_without_new_compiles():
         assert cli.stats()["engine"]["compiled_programs"] == compiled
     finally:
         srv.stop()
+
+
+def test_client_reconnects_when_connection_dies_mid_response():
+    """Regression: reconnect-once used to cover only sockets that died
+    BEFORE the request went out; a connection dropped AFTER headers,
+    mid-body, surfaced http.client.IncompleteRead to the caller. The
+    client now redials once and replays — the exact path a replica
+    restart-in-place exercises against pooled keep-alive connections."""
+    import json
+    import socket
+
+    good = json.dumps({"tokens": [1, 2], "prompt_len": 1}).encode()
+    accepts = []
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(2)
+    port = lst.getsockname()[1]
+
+    def serve():
+        for i in range(2):
+            c, _ = lst.accept()
+            accepts.append(i)
+            c.settimeout(10)
+            try:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += c.recv(65536)
+            except OSError:
+                pass
+            if i == 0:
+                # promise 100 body bytes, deliver 10, then kill the socket
+                c.sendall(b"HTTP/1.1 200 OK\r\n"
+                          b"Content-Type: application/json\r\n"
+                          b"Content-Length: 100\r\n\r\n0123456789")
+            else:
+                c.sendall(b"HTTP/1.1 200 OK\r\n"
+                          b"Content-Type: application/json\r\n"
+                          + f"Content-Length: {len(good)}\r\n\r\n".encode()
+                          + good)
+            c.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    cli = InferenceClient(f"http://127.0.0.1:{port}", retries=1)
+    try:
+        status, body, _ = cli.post_raw("/generate", b"{}")
+        assert status == 200
+        assert body == good                   # the REPLAYED full response
+        assert accepts == [0, 1]              # it really redialed
+    finally:
+        cli.close()
+        lst.close()
+    t.join(timeout=10)
