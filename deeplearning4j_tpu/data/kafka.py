@@ -191,13 +191,17 @@ class NDArrayPubSubRoute:
     which is the role consumer lag plays in the reference)."""
 
     def __init__(self, client: BrokerClient, topic: str, batch_size: int,
-                 buffer_records: int = 1024):
+                 buffer_records: int = 1024,
+                 stall_timeout: Optional[float] = None):
         self.client = client
         self.topic = topic
         # finite push timeout so a backpressure-blocked pump re-checks the
-        # stop flag instead of blocking in the buffer forever
+        # stop flag instead of blocking in the buffer forever;
+        # stall_timeout lets a consumer surface StreamStalledError when the
+        # topic goes silent (online trainers degrade health, not crash)
         self.iterator = StreamingDataSetIterator(
-            batch_size, buffer_records=buffer_records, push_timeout=0.5)
+            batch_size, buffer_records=buffer_records, push_timeout=0.5,
+            stall_timeout=stall_timeout)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

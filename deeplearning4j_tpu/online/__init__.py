@@ -1,0 +1,44 @@
+"""Online learning loop: stream-fed fine-tuning with shadow-evaluated
+hot-swap deployments and instant rollback (docs/ONLINE_LEARNING.md).
+
+The loop (ROADMAP item 4) closes training and serving into one service:
+
+    Kafka topic → NDArrayPubSubRoute → OnlineTrainer (guarded fine-tune,
+    atomic checkpoints) → PromotionGate (held-out eval + mirrored live
+    traffic vs the incumbent) → Deployer (pin → swap every serving target
+    with zero new XLA compiles → unpin superseded) → post-promotion
+    regression watch → automatic rollback to the pinned incumbent.
+
+Module map:
+
+- ``stream``  — DriftingProblem: the deterministic synthetic task whose
+  label boundary drifts by phase, so "keep learning or degrade" is testable.
+- ``trainer`` — BatchGuard (NaN / loss-spike quarantine) + OnlineTrainer
+  (bounded rounds off a streaming iterator, crash-safe checkpoints,
+  stall-degraded health).
+- ``gate``    — TrafficMirror (bounded tap of live /predict traffic) +
+  PromotionGate (candidate vs incumbent on the eval set, shadow
+  disagreement on mirrored traffic).
+- ``deploy``  — SwapTargets (in-process engine / server, HTTP admin
+  endpoint) + Deployer (pin choreography, atomic intent file, crash
+  recovery mid-promotion, monotonic model versions, rollback).
+- ``service`` — OnlineLearningService: one ``step()`` = train round →
+  gate → promote → regression watch → rollback; ``health_info`` plugs
+  into InferenceServer's ``health_hook``.
+"""
+
+from deeplearning4j_tpu.online.stream import DriftingProblem
+from deeplearning4j_tpu.online.trainer import BatchGuard, OnlineTrainer
+from deeplearning4j_tpu.online.gate import (GateDecision, PromotionGate,
+                                            TrafficMirror)
+from deeplearning4j_tpu.online.deploy import (Deployer, EngineTarget,
+                                              HttpTarget, ServerTarget)
+from deeplearning4j_tpu.online.service import OnlineLearningService
+
+__all__ = [
+    "DriftingProblem",
+    "BatchGuard", "OnlineTrainer",
+    "GateDecision", "PromotionGate", "TrafficMirror",
+    "Deployer", "EngineTarget", "HttpTarget", "ServerTarget",
+    "OnlineLearningService",
+]

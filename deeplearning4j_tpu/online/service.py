@@ -1,0 +1,124 @@
+"""The assembled online-learning loop: one ``step()`` per control cycle.
+
+``OnlineLearningService`` wires the pieces of this package into the
+train → gate → promote → watch → rollback cycle documented in
+docs/ONLINE_LEARNING.md:
+
+1. ``trainer.run_round()`` — guarded fine-tune, one atomic checkpoint;
+2. ``gate.decide`` — candidate (the freshly trained model) vs incumbent
+   (the currently promoted checkpoint, loaded into a scratch net so the
+   serving engines are never touched by evaluation);
+3. ``deployer.promote`` — pin, swap every target (zero new XLA compiles),
+   record;
+4. **regression watch** — immediately after promotion the live model is
+   re-scored; if quality fell more than ``regression_margin`` below the
+   pre-promotion incumbent, ``deployer.rollback()`` restores the pinned
+   incumbent under a fresh version. The gate should make this unreachable
+   (it just measured the candidate as better); the watch exists for the
+   gap the gate cannot see — eval sets go stale, and a configuration
+   error (margin set too loose, eval set too small) should degrade to
+   "brief bad window, then automatic rollback", never "bad model until a
+   human notices".
+
+``health_info`` merges the trainer's stall state into the serving
+server's ``health_hook``, so a silent stream degrades /healthz while
+requests keep being served on the incumbent weights.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["OnlineLearningService"]
+
+
+class OnlineLearningService:
+    """One control loop over trainer + gate + deployer.
+
+    ``scratch_model`` must be architecturally identical to the trainer's
+    model (same conf — e.g. another ``build_model("mlp")``); it is the
+    evaluation stand-in for whichever checkpoint is currently promoted.
+    """
+
+    def __init__(self, trainer, gate, deployer, scratch_model,
+                 mirror=None, regression_margin: float = 0.05):
+        if regression_margin < 0:
+            raise ValueError("regression_margin must be >= 0, got "
+                             f"{regression_margin}")
+        self.trainer = trainer
+        self.gate = gate
+        self.deployer = deployer
+        self.scratch = scratch_model
+        self.mirror = mirror
+        self.regression_margin = float(regression_margin)
+
+    # -- model handles -----------------------------------------------------
+
+    def _candidate_fn(self):
+        return lambda x: np.asarray(self.trainer.model.output(x))
+
+    def _incumbent_fn(self):
+        """Predict-fn for the promoted checkpoint, or None before the first
+        promotion (bootstrap)."""
+        cur = self.deployer.current
+        if cur is None:
+            return None
+        from deeplearning4j_tpu.util.model_serializer import load_weights
+        params, state = load_weights(self.scratch, cur["checkpoint"])
+        self.scratch.params, self.scratch.state = params, state
+        return lambda x: np.asarray(self.scratch.output(x))
+
+    # -- the cycle ---------------------------------------------------------
+
+    def step(self) -> dict:
+        """Run one full cycle; returns a summary dict (keys: trained,
+        checkpoint, decision, promoted, version, rolled_back,
+        live_quality, stalled, quarantined)."""
+        out = {"trained": False, "checkpoint": None, "decision": None,
+               "promoted": False, "version": self.deployer.version,
+               "rolled_back": False, "live_quality": None,
+               "stalled": False, "quarantined": self.trainer.quarantined}
+        ck = self.trainer.run_round()
+        out["stalled"] = self.trainer.stalled
+        out["quarantined"] = self.trainer.quarantined
+        if ck is None:
+            return out
+        out["trained"] = True
+        out["checkpoint"] = ck
+
+        candidate_fn = self._candidate_fn()
+        decision = self.gate.decide(candidate_fn, self._incumbent_fn(),
+                                    self.mirror)
+        out["decision"] = decision.as_dict()
+        if not decision.promote:
+            log.info("online gate held back %s: %s", ck, decision.reason)
+            return out
+
+        version = self.deployer.promote(ck)
+        out["promoted"], out["version"] = True, version
+
+        # regression watch: score what is NOW live against the quality the
+        # tier had before this promotion
+        live_q = self.gate.evaluate(candidate_fn)
+        out["live_quality"] = live_q
+        baseline = decision.incumbent_quality
+        if (np.isfinite(baseline)
+                and live_q < baseline - self.regression_margin):
+            rb = self.deployer.rollback()
+            out["rolled_back"], out["version"] = True, rb
+            log.error("online promotion v%d regressed quality %.4f → %.4f "
+                      "(margin %.4f); rolled back as v%d",
+                      version, baseline, live_q,
+                      self.regression_margin, rb)
+        return out
+
+    # -- health ------------------------------------------------------------
+
+    def health_info(self) -> Optional[dict]:
+        """InferenceServer ``health_hook`` delegate."""
+        return self.trainer.health_info()

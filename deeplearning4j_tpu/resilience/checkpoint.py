@@ -24,6 +24,7 @@ modulo test can skip its own cadence forever.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import re
@@ -176,6 +177,39 @@ class CheckpointManager:
                 os.unlink(victim.path(self.directory))
             except OSError:
                 pass
+
+    def pin(self, iteration: int) -> Checkpoint:
+        """Pin the checkpoint saved at ``iteration`` after the fact so it is
+        exempt from ``keep_last`` rotation — what a promotion pins so its
+        rollback target survives arbitrarily long training runs. Idempotent;
+        raises ``ValueError`` when no live checkpoint has that iteration."""
+        return self._set_pinned(iteration, True)
+
+    def unpin(self, iteration: int) -> Checkpoint:
+        """Drop the pin on ``iteration``'s checkpoint. The entry immediately
+        re-enters ``keep_last`` rotation (and may be rotated away by this
+        very call if it is already outside the recent window)."""
+        return self._set_pinned(iteration, False)
+
+    def _set_pinned(self, iteration: int, flag: bool) -> Checkpoint:
+        iteration = int(iteration)
+        hits = [i for i, c in enumerate(self._entries)
+                if c.iteration == iteration]
+        if not hits:
+            live = sorted(c.iteration for c in self._entries)
+            raise ValueError(
+                f"no checkpoint at iteration {iteration} in "
+                f"{self.directory} (live iterations: {live})")
+        entry = self._entries[hits[0]]
+        if entry.pinned != flag:
+            for i in hits:
+                self._entries[i] = dataclasses.replace(self._entries[i],
+                                                       pinned=flag)
+            entry = self._entries[hits[0]]
+            if not flag:
+                self._rotate()
+            self._write_manifest()
+        return entry
 
     def checkpoints(self) -> List[Checkpoint]:
         return list(self._entries)

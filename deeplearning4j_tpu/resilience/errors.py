@@ -87,6 +87,24 @@ class CorruptCheckpointError(ValueError):
             f"corrupt or truncated checkpoint {self.path}{where}{why}")
 
 
+class WeightSwapError(ValueError):
+    """A hot-swap candidate pytree does not match the serving engine's live
+    weights — missing/extra arrays, or a shape/dtype mismatch. Raised BEFORE
+    any engine state is touched, so a rejected swap leaves serving exactly as
+    it was; the admin endpoint maps it to HTTP 409. ``mismatches`` lists the
+    offending array paths with expected-vs-got detail."""
+
+    def __init__(self, message: str, mismatches=None):
+        self.mismatches = list(mismatches or ())
+        if self.mismatches:
+            shown = "; ".join(self.mismatches[:3])
+            more = len(self.mismatches) - 3
+            if more > 0:
+                shown += f"; … {more} more"
+            message = f"{message}: {shown}"
+        super().__init__(message)
+
+
 class StreamStalledError(TimeoutError):
     """A streaming iterator saw no data for longer than ``stall_timeout``
     while the stream was still nominally open — the producer likely died

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.monitor import get_registry, trace
 from deeplearning4j_tpu.resilience.errors import (
     BatcherStoppedError, ServerOverloadedError)
+from deeplearning4j_tpu.serving.engine import validate_swap
 
 
 class _Request:
@@ -105,6 +106,9 @@ class DecodeEngine:
 
         self._step = jax.jit(self._step_impl, donate_argnums=(2,))
         self._dstate = None
+        self._live = None          # (params, state) after the first swap
+        self._pending_swap = None  # staged (params, state, version, Event)
+        self._version = 0
         self._slot_reqs: List[Optional[_Request]] = [None] * self.slots
         self._queue: deque = deque()
         self._cv = threading.Condition()
@@ -138,10 +142,77 @@ class DecodeEngine:
             "Per-token latency: wall seconds of one batched step (every "
             "active stream advances one token per step).",
             ("engine",)).labels(**lab)
+        self._m_version = reg.gauge(
+            "dl4jtpu_model_version",
+            "Version of the weights currently serving (0 = the model's "
+            "initial weights; bumped by every hot swap).",
+            ("engine",)).labels(**lab)
+        self._m_swaps = reg.counter(
+            "dl4jtpu_model_swaps_total",
+            "Weight hot-swaps applied with zero new XLA compiles.",
+            ("engine",)).labels(**lab)
+        self._m_version.set(0.0)
 
     @property
     def trace_count(self) -> int:
         return int(self._m_compiled.value)
+
+    @property
+    def model_version(self) -> int:
+        return self._version
+
+    def _weights(self):
+        """Live (params, state): the engine's own pair after a swap was
+        applied, the model's until then (so a freshly built engine still
+        follows further ``fit()`` calls on its model)."""
+        live = self._live
+        if live is not None:
+            return live
+        return self.model.params, self.model.state
+
+    def swap_weights(self, params, state=None, version: Optional[int] = None,
+                     timeout: Optional[float] = 60.0) -> int:
+        """Stage a same-shape weight swap and wait for it to apply.
+
+        Continuous batching means slots from different requests share every
+        device call, and a generation must run END-TO-END on one model
+        version — so the swap is deferred: admission pauses, in-flight
+        generations finish on the old weights (bounded by their remaining
+        ``max_new_tokens``), and the loop applies the swap at the first
+        step boundary with zero live slots, then re-admits. The candidate
+        is validated BEFORE staging (``WeightSwapError`` leaves the engine
+        untouched), and identical shapes/dtypes mean the single compiled
+        step program is reused — zero new XLA compiles."""
+        cur_p, cur_s = self._weights()
+        validate_swap(cur_p, params, "decode params")
+        if state is not None:
+            validate_swap(cur_s, state, "decode state")
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        state = (cur_s if state is None
+                 else jax.tree_util.tree_map(jnp.asarray, state))
+        applied = threading.Event()
+        with self._cv:
+            self._pending_swap = (params, state, version, applied)
+            self._cv.notify_all()
+            if self._thread is None or not self._thread.is_alive():
+                self._apply_swap_locked()   # no loop running: apply now
+        if timeout is not None and not applied.wait(timeout):
+            raise TimeoutError(
+                f"decode weight swap not applied within {timeout}s "
+                f"(in-flight generations still draining)")
+        return self._version
+
+    def _apply_swap_locked(self) -> None:
+        """Apply the staged swap (caller holds ``self._cv``, no live
+        slots)."""
+        params, state, version, applied = self._pending_swap
+        self._pending_swap = None
+        self._live = (params, state)
+        self._version = (int(version) if version is not None
+                         else self._version + 1)
+        self._m_version.set(float(self._version))
+        self._m_swaps.inc()
+        applied.set()
 
     @property
     def saturated(self) -> bool:
@@ -220,6 +291,10 @@ class DecodeEngine:
             self._thread.join(timeout=10.0)
         err = BatcherStoppedError("decode engine stopped")
         with self._cv:
+            if self._pending_swap is not None:
+                # a swap staged against a stopping engine still applies (and
+                # unblocks its waiter) — a restart serves the new weights
+                self._apply_swap_locked()
             pending = list(self._queue)
             self._queue.clear()
             live = [r for r in self._slot_reqs if r is not None]
@@ -241,8 +316,9 @@ class DecodeEngine:
         z = np.zeros(S, np.int32)
         f = np.zeros(S, bool)
         t0 = time.perf_counter()
+        params, state = self._weights()
         tok, self._dstate = self._step(
-            self.model.params, self.model.state, self._dstate, z, z, f, f,
+            params, state, self._dstate, z, z, f, f,
             np.zeros(S, np.uint32), np.zeros(S, np.float32), z)
         jax.block_until_ready(tok)
         self.warmup_seconds = time.perf_counter() - t0
@@ -283,6 +359,8 @@ class DecodeEngine:
                            top_k).result(timeout=timeout)
 
     def _admit_locked(self):
+        if self._pending_swap is not None:
+            return          # admission pauses so live slots can drain
         for i in range(self.slots):
             if not self._queue:
                 break
@@ -293,6 +371,11 @@ class DecodeEngine:
         S = self.slots
         while not self._stop.is_set():
             with self._cv:
+                if (self._pending_swap is not None
+                        and all(r is None for r in self._slot_reqs)):
+                    # step boundary with no live slots: every in-flight
+                    # generation ran end-to-end on the old weights
+                    self._apply_swap_locked()
                 self._admit_locked()
                 live = [(i, r) for i, r in enumerate(self._slot_reqs)
                         if r is not None]
@@ -318,9 +401,10 @@ class DecodeEngine:
                 temps[i] = r.temperature
                 topk[i] = r.top_k
             t0 = time.perf_counter()
+            params, state = self._weights()
             with trace.span("decode_step", active=len(live)):
                 nt, self._dstate = self._step(
-                    self.model.params, self.model.state, self._dstate,
+                    params, state, self._dstate,
                     tokens, pos, reset, active, seeds, temps, topk)
                 nt = np.asarray(nt)
             dt = time.perf_counter() - t0
@@ -357,6 +441,7 @@ class DecodeEngine:
         return {"id": self.id,
                 "slots": self.slots,
                 "max_len": self.max_len,
+                "model_version": self._version,
                 "occupied_slots": occupied,
                 "queued_requests": queued,
                 "compiled_programs": self.trace_count,

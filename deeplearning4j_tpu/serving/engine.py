@@ -37,6 +37,41 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.monitor import get_registry, trace
+from deeplearning4j_tpu.resilience.errors import WeightSwapError
+
+
+def _tree_signature(tree):
+    """Flattened ``{path: (shape, dtype)}`` of a pytree — the swap
+    compatibility key. Same path convention as util/model_serializer."""
+    sig = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        arr = leaf if hasattr(leaf, "shape") else np.asarray(leaf)
+        sig[key] = (tuple(arr.shape), str(arr.dtype))
+    return sig
+
+
+def validate_swap(current, candidate, what: str = "params") -> None:
+    """Reject a hot-swap candidate whose pytree does not match the live
+    weights array-for-array (path set, shapes, dtypes). Raising HERE — before
+    any engine state is touched — is what makes a rejected swap a no-op; a
+    mismatch that slipped through would either retrace a fresh XLA program
+    (shape/dtype change) or crash a device call mid-request."""
+    cur, new = _tree_signature(current), _tree_signature(candidate)
+    problems = []
+    for key in sorted(set(cur) - set(new)):
+        problems.append(f"missing array {key!r}")
+    for key in sorted(set(new) - set(cur)):
+        problems.append(f"unexpected array {key!r}")
+    for key in sorted(set(cur) & set(new)):
+        if cur[key] != new[key]:
+            problems.append(
+                f"{key!r} expected {cur[key][0]}/{cur[key][1]}, "
+                f"got {new[key][0]}/{new[key][1]}")
+    if problems:
+        raise WeightSwapError(
+            f"candidate {what} incompatible with live weights", problems)
 
 
 def bucket_for(n: int, max_batch: int, min_bucket: int = 1) -> int:
@@ -68,6 +103,14 @@ class InferenceEngine:
     Parameters are read from the model at call time, so the engine stays
     valid across further ``fit()`` calls — only the program structure is
     cached, never the weights.
+
+    ``swap_weights`` hot-swaps the serving weights for a same-shape pytree
+    (the online-learning deploy path, docs/ONLINE_LEARNING.md): after the
+    first swap the engine serves its own pinned ``(params, state)`` pair
+    instead of reading the model, so a trainer mutating the model can no
+    longer affect serving. Identical shapes/dtypes mean the jit cache hits —
+    a swap performs ZERO new XLA compiles by construction (the regression
+    tests pin ``trace_count`` across swaps).
     """
 
     _ids = itertools.count()
@@ -79,6 +122,8 @@ class InferenceEngine:
         self._traced_keys = set()
         self._fwd = None
         self._lock = threading.Lock()
+        self._live = None          # (params, state) after the first swap
+        self._version = 0
         self._is_graph = hasattr(model.conf, "network_inputs")
         self.warmup_seconds: Optional[float] = None
         # registry-backed counters: /stats and /metrics read the SAME cells
@@ -97,12 +142,63 @@ class InferenceEngine:
             "dl4jtpu_serving_pad_rows_total",
             "Padding rows added to round batches up to bucket sizes "
             "(pad-waste = pad / (pad + rows)).", ("engine",)).labels(**lab)
+        self._m_version = reg.gauge(
+            "dl4jtpu_model_version",
+            "Version of the weights currently serving (0 = the model's "
+            "initial weights; bumped by every hot swap).",
+            ("engine",)).labels(**lab)
+        self._m_swaps = reg.counter(
+            "dl4jtpu_model_swaps_total",
+            "Weight hot-swaps applied with zero new XLA compiles.",
+            ("engine",)).labels(**lab)
+        self._m_version.set(0.0)
 
     @property
     def trace_count(self) -> int:
         """Compiled-program count (reads the registry counter — the single
         source of truth shared with ``/metrics``)."""
         return int(self._m_compiled.value)
+
+    @property
+    def model_version(self) -> int:
+        return self._version
+
+    def _weights(self):
+        """The live (params, state) pair: the engine's own swapped weights
+        once a swap happened, the model's otherwise. Read under the lock so
+        a concurrent swap can never tear params against state."""
+        with self._lock:
+            if self._live is not None:
+                return self._live
+        return self.model.params, self.model.state
+
+    def swap_weights(self, params, state=None, version: Optional[int] = None):
+        """Atomically replace the serving weights with a same-shape pytree.
+
+        The candidate is validated (path set, shapes, dtypes) BEFORE any
+        state changes — a mismatch raises ``WeightSwapError`` and leaves the
+        engine untouched. In-flight ``predict`` calls already captured their
+        weight references and finish on the old weights; subsequent
+        dispatches see the new pair. Same shapes/dtypes → the cached jitted
+        forward is reused, so a swap costs zero new XLA compiles. Returns
+        the new model version (``version`` or previous + 1)."""
+        cur_p, cur_s = self._weights()
+        validate_swap(cur_p, params, "params")
+        if state is not None:
+            validate_swap(cur_s, state, "state")
+        # device-resident once, at swap time — numpy trees fresh from a
+        # checkpoint zip would otherwise pay a host→device copy per request
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        state = (cur_s if state is None
+                 else jax.tree_util.tree_map(jnp.asarray, state))
+        with self._lock:
+            self._live = (params, state)
+            self._version = (int(version) if version is not None
+                             else self._version + 1)
+            v = self._version
+        self._m_version.set(float(v))
+        self._m_swaps.inc()
+        return v
 
     # ------------------------------------------------------------- forward
     def _forward_fn(self):
@@ -163,8 +259,8 @@ class InferenceEngine:
             padded = [self._pad_rows(x, b) for x in inputs]
             mask_p = None if mask is None else self._pad_rows(mask, b)
         with trace.span("device", bucket=b):
-            outs = self._forward_fn()(self.model.params, self.model.state,
-                                      padded, mask_p)
+            params, state = self._weights()
+            outs = self._forward_fn()(params, state, padded, mask_p)
         self._m_rows.inc(n)
         self._m_pad_rows.inc(b - n)
         return [o[:n] for o in outs]
@@ -250,6 +346,7 @@ class InferenceEngine:
                 "max_batch": self.max_batch,
                 "bucket_ladder": bucket_ladder(self.max_batch,
                                                self.min_bucket),
+                "model_version": self._version,
                 "compiled_programs": self.trace_count,
                 "rows": int(rows),
                 "pad_rows": int(pad),

@@ -107,6 +107,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="mount POST /chaos (test-only fault injection)")
     parser.add_argument("--warmup", action="store_true",
                         help="pre-compile before accepting traffic")
+    parser.add_argument("--checkpoint", default=None,
+                        help="swap in the weights of this checkpoint zip "
+                             "before accepting traffic (restart from a "
+                             "promoted online-learning checkpoint)")
     args = parser.parse_args(argv)
 
     # CPU platform before anything touches a backend: replicas are test
@@ -127,6 +131,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     srv.start()
     if args.warmup and args.model == "mlp":
         srv.engine.warmup((4,), max_batch=64)
+    if args.checkpoint:
+        # boot-time deploy of a promoted checkpoint: the replica starts from
+        # its deterministic seed weights and swaps (zero extra compiles,
+        # same shapes) rather than deserialising a whole different conf
+        v = srv.swap_checkpoint(args.checkpoint)
+        print(f"REPLICA_SWAPPED version={v} "
+              f"checkpoint={args.checkpoint}", flush=True)
 
     stopping = []
 
@@ -178,7 +189,7 @@ class ReplicaProcess:
     def __init__(self, workdir: str, model: str = "charlstm",
                  slots: int = 4, max_len: int = 64,
                  chaos: bool = True, warmup: bool = True,
-                 name: str = "replica"):
+                 name: str = "replica", checkpoint: Optional[str] = None):
         self.workdir = workdir
         self.model = model
         self.slots = slots
@@ -186,6 +197,9 @@ class ReplicaProcess:
         self.chaos = chaos
         self.warmup = warmup
         self.name = name
+        # mutable: rolling restarts set this to the latest promoted
+        # checkpoint so a restarted replica boots on current weights
+        self.checkpoint = checkpoint
         self.port: Optional[int] = None
         self.proc: Optional[subprocess.Popen] = None
         self._log = os.path.join(workdir, f"{name}.log")
@@ -207,6 +221,8 @@ class ReplicaProcess:
             cmd.append("--chaos")
         if self.warmup:
             cmd.append("--warmup")
+        if self.checkpoint:
+            cmd.extend(["--checkpoint", os.fspath(self.checkpoint)])
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = (_repo_root() + os.pathsep

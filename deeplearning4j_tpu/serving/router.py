@@ -191,12 +191,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
 
-    def _reply(self, status: int, body: bytes, rid: Optional[str] = None):
+    def _reply(self, status: int, body: bytes, rid: Optional[str] = None,
+               extra_headers=None):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if rid:
             self.send_header("x-request-id", rid)
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -232,12 +235,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 {"error": {"type": "not_found",
                            "message": f"no such path: {path}"}}).encode())
             return
-        status, out, rid = router.handle(
+        status, out, rid, extra = router.handle(
             path, body,
             tenant=self.headers.get("x-tenant", "default"),
             priority=self.headers.get("x-priority", "normal"),
             request_id=self.headers.get("x-request-id"))
-        self._reply(status, out, rid)
+        self._reply(status, out, rid, extra)
 
 
 class Router:
@@ -520,7 +523,7 @@ class Router:
     def _err(self, status: int, err_type: str, message: str, rid: str):
         return status, json.dumps(
             {"error": {"type": err_type, "message": message,
-                       "request_id": rid}}).encode(), rid
+                       "request_id": rid}}).encode(), rid, {}
 
     def _hedge_delay_s(self) -> float:
         if self.hedge_delay_ms is not None:
@@ -571,9 +574,11 @@ class Router:
     def handle(self, path: str, body: bytes, tenant: str = "default",
                priority: str = "normal",
                request_id: Optional[str] = None):
-        """Route one request; returns ``(status, body_bytes, request_id)``.
-        Exposed directly (not just via HTTP) so tests can drive the router
-        without sockets where sockets add nothing."""
+        """Route one request; returns ``(status, body_bytes, request_id,
+        extra_headers)`` — ``extra_headers`` passes upstream metadata
+        (``x-model-version``, the replica's hot-swap weight version) through
+        to the client. Exposed directly (not just via HTTP) so tests can
+        drive the router without sockets where sockets add nothing."""
         rid = self._mint_rid(request_id)
         self.budget.deposit()
         shed = self._admit(tenant, priority, rid)
@@ -608,13 +613,14 @@ class Router:
         t0 = time.perf_counter()
         try:
             att.conn = rep.client._conn()
-            status, data, _hdrs = rep.client.post_raw(
+            status, data, hdrs = rep.client.post_raw(
                 path, body, headers={"x-request-id": att.rid},
                 give_up=att.cancelled.is_set)
-            results.put((att, status, data, None,
+            results.put((att, status, data, hdrs, None,
                          time.perf_counter() - t0))
         except Exception as e:  # noqa: BLE001 — classified by the waiter
-            results.put((att, None, None, e, time.perf_counter() - t0))
+            results.put((att, None, None, None, e,
+                         time.perf_counter() - t0))
         finally:
             with rep.lock:
                 rep.outstanding -= 1
@@ -676,7 +682,7 @@ class Router:
                 timeout = min(timeout, hedge_at - now) \
                     if timeout is not None else hedge_at - now
             try:
-                att, status, data, exc, dt = results.get(
+                att, status, data, hdrs, exc, dt = results.get(
                     timeout=max(0.001, timeout) if timeout is not None
                     else None)
             except queue.Empty:
@@ -717,7 +723,12 @@ class Router:
                     outcome("failed_over")
                 else:
                     outcome("ok")
-                return status, data, rid
+                extra = {}
+                mv = next((v for k, v in (hdrs or {}).items()
+                           if k.lower() == "x-model-version"), None)
+                if mv is not None:
+                    extra["x-model-version"] = mv
+                return status, data, rid, extra
 
             self._note_failure(rep, self._classify_failure(status, exc))
             if live:

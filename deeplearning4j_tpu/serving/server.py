@@ -8,9 +8,15 @@ coalesced into shared device calls. Wire format in docs/SERVING.md.
 Endpoints:
   POST /predict  {"ndarray": {shape, data}, "deadline_ms"?} → {"ndarray": ...}
   POST /warmup   {"input_shape": [...], "max_batch"}        → {"buckets": [...]}
+  POST /admin/swap {"checkpoint": path, "version"?}         → {"version": n}
   GET  /stats                                               → engine+batcher stats
   GET  /metrics                                             → Prometheus text
   GET  /healthz                                             → {"status": ...}
+
+/predict and /generate responses carry ``x-model-version`` (the serving
+weights' hot-swap version, docs/ONLINE_LEARNING.md); 409 with type
+``weight_mismatch`` rejects an incompatible /admin/swap candidate before
+the live engines are touched.
 
 Error contract (docs/FAULT_TOLERANCE.md): every error body is structured —
 ``{"error": {"type": ..., "message": ...}}`` — and the status code
@@ -39,13 +45,13 @@ from deeplearning4j_tpu.clustering.knn_server import (
     ndarray_from_b64, ndarray_to_b64)
 from deeplearning4j_tpu.monitor import get_registry, trace
 from deeplearning4j_tpu.resilience.errors import (
-    BatcherStoppedError, DeadlineExceededError, InjectedFaultError,
-    ServerOverloadedError)
+    BatcherStoppedError, CorruptCheckpointError, DeadlineExceededError,
+    InjectedFaultError, ServerOverloadedError, WeightSwapError)
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 
 _KNOWN_PATHS = ("/predict", "/generate", "/warmup", "/stats", "/metrics",
-                "/healthz", "/chaos")
+                "/healthz", "/chaos", "/admin/swap")
 
 
 def _http_metrics():
@@ -79,13 +85,15 @@ class _Handler(BaseHTTPRequestHandler):
         # the router, both halves of a hedged pair, and the replica
         return self.headers.get("x-request-id")
 
-    def _json(self, obj, code=200):
+    def _json(self, obj, code=200, extra_headers=None):
         data = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
         if self._rid:
             self.send_header("x-request-id", self._rid)
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -169,6 +177,8 @@ class _Handler(BaseHTTPRequestHandler):
                     else:
                         srv.fault_injector.configure(**payload)
                         self._json({"chaos": srv.fault_injector.describe()})
+                elif path == "/admin/swap":
+                    self._admin_swap(srv, payload)
                 elif path == "/warmup":
                     try:
                         shape = payload["input_shape"]
@@ -186,6 +196,11 @@ class _Handler(BaseHTTPRequestHandler):
                     self._error(404, "not_found", f"no such path: {path}")
             except BadRequestError as e:
                 self._error(400, "bad_request", str(e))
+            except WeightSwapError as e:
+                # structured rejection: the live engines were never touched
+                self._error(409, "weight_mismatch", str(e))
+            except (CorruptCheckpointError, FileNotFoundError) as e:
+                self._error(400, "bad_checkpoint", str(e))
             except InjectedFaultError as e:
                 self._error(e.code, "injected_fault", str(e))
             except ServerOverloadedError as e:
@@ -200,6 +215,26 @@ class _Handler(BaseHTTPRequestHandler):
                             f"{type(e).__name__}: {e}")
 
         self._observed(path, handle)
+
+    def _admin_swap(self, srv, payload):
+        """POST /admin/swap {"checkpoint": path, "version"?: int} — load a
+        checkpoint's weights and hot-swap them into the live engines (the
+        online-learning deploy path; see docs/ONLINE_LEARNING.md)."""
+        try:
+            ck = payload["checkpoint"]
+        except KeyError:
+            raise BadRequestError("payload missing 'checkpoint'") from None
+        version = payload.get("version")
+        if version is not None:
+            try:
+                version = int(version)
+            except (TypeError, ValueError):
+                raise BadRequestError(
+                    f"version must be an int, got {version!r}") from None
+        v = srv.swap_checkpoint(ck, version=version)
+        self._json({"swapped": True, "version": v,
+                    "checkpoint": str(ck),
+                    "compiled_programs": srv.engine.trace_count})
 
     def _predict(self, srv, payload):
         try:
@@ -222,13 +257,25 @@ class _Handler(BaseHTTPRequestHandler):
         if squeeze:
             x = x[None, :]
         srv.validate_features(x)
+        if srv.request_mirror is not None:
+            try:
+                # shadow-evaluation tap (online/gate.TrafficMirror): a copy
+                # of real traffic, never allowed to fail a real request
+                srv.request_mirror(x)
+            except Exception:   # noqa: BLE001 — mirror is best-effort
+                pass
         # block=False: a full queue answers 429 NOW — the handler thread is
         # never parked on backpressure while the client waits
         fut = srv.batcher.submit(x, deadline_ms=deadline_ms, block=False)
         out = fut.result()
         if squeeze:
             out = out[0]
-        self._json({"ndarray": ndarray_to_b64(out)})
+        # version read at response time: a request racing a swap may report
+        # the new version for an answer computed on the old weights — the
+        # benign direction (versions only move forward; see the docs)
+        self._json({"ndarray": ndarray_to_b64(out)},
+                   extra_headers={
+                       "x-model-version": str(srv.engine.model_version)})
 
     def _generate(self, srv, payload):
         if srv.decode_engine is None:
@@ -251,7 +298,8 @@ class _Handler(BaseHTTPRequestHandler):
                 top_k=int(payload.get("top_k", 0)))
         except ValueError as e:     # capacity / id-range problems → 400
             raise BadRequestError(str(e)) from None
-        self._json(out)
+        self._json(out, extra_headers={
+            "x-model-version": str(srv.decode_engine.model_version)})
 
 
 class InferenceServer:
@@ -270,7 +318,8 @@ class InferenceServer:
                  engine: Optional[InferenceEngine] = None,
                  max_queue: int = 1024,
                  request_timeout_ms: Optional[float] = None,
-                 decode_engine=None, fault_injector=None):
+                 decode_engine=None, fault_injector=None,
+                 health_hook=None, request_mirror=None):
         self.engine = engine or InferenceEngine(model)
         # serving/decode.DecodeEngine for POST /generate (None = endpoint
         # answers 404; predict-only servers don't pay for decode slots)
@@ -279,6 +328,13 @@ class InferenceServer:
         # /predict and /generate pass through it (latency / injected 5xx)
         # and POST /chaos reconfigures it live; None = no chaos surface
         self.fault_injector = fault_injector
+        # health_hook: () -> {"status": ...} | None — extra health merged
+        # into /healthz (the online trainer degrades serving health on a
+        # stalled stream instead of dying; docs/ONLINE_LEARNING.md)
+        self.health_hook = health_hook
+        # request_mirror: (features ndarray) -> None — best-effort tap on
+        # /predict traffic (online/gate.TrafficMirror shadow evaluation)
+        self.request_mirror = request_mirror
         self.batcher = MicroBatcher(self.engine, max_batch=max_batch,
                                     max_latency_ms=max_latency_ms,
                                     max_queue=max_queue)
@@ -326,6 +382,13 @@ class InferenceServer:
             return {"status": "degraded", "reason": "queue_pressure"}
         if self.decode_engine is not None and self.decode_engine.saturated:
             return {"status": "degraded", "reason": "decode_saturated"}
+        if self.health_hook is not None:
+            try:
+                extra = self.health_hook()
+            except Exception:   # noqa: BLE001 — a broken hook can't take
+                extra = None    # the whole server unhealthy
+            if extra and extra.get("status") not in (None, "ok"):
+                return extra
         return {"status": "ok"}
 
     def health(self) -> str:
@@ -335,10 +398,36 @@ class InferenceServer:
         out = {"engine": self.engine.stats(),
                "batcher": self.batcher.stats(),
                "health": self.health(),
+               "model_version": self.engine.model_version,
                "last_error": self.last_error}
         if self.decode_engine is not None:
             out["decode"] = self.decode_engine.stats()
         return out
+
+    # ------------------------------------------------------------- hot swap
+    def swap_weights(self, params, state=None,
+                     version: Optional[int] = None) -> int:
+        """Hot-swap both engines to a same-shape weight pytree. The decode
+        engine (if any) stages first and applies at its next empty step
+        boundary — in-flight generations finish on the old weights — then
+        /predict cuts over. Validation happens before either engine is
+        touched, so a ``WeightSwapError`` leaves serving exactly as it was.
+        Returns the new model version."""
+        if version is None:
+            version = self.engine.model_version + 1
+        if self.decode_engine is not None:
+            self.decode_engine.swap_weights(params, state, version=version)
+        return self.engine.swap_weights(params, state, version=version)
+
+    def swap_checkpoint(self, path, version: Optional[int] = None) -> int:
+        """Load a checkpoint zip's (params, state) and hot-swap them in —
+        what POST /admin/swap calls. The zip's own configuration is ignored
+        (see model_serializer.load_weights), so head-only transfer-learning
+        checkpoints swap into the full serving net."""
+        from deeplearning4j_tpu.util import model_serializer
+        params, state = model_serializer.load_weights(self.engine.model,
+                                                      path)
+        return self.swap_weights(params, state, version=version)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceServer":

@@ -41,8 +41,8 @@ META_NAME = "meta.json"
 __all__ = [
     "CorruptCheckpointError", "write_model", "restore_multi_layer_network",
     "restore_computation_graph", "restore_into", "restore_normalizer",
-    "read_meta", "guess_model", "META_NAME", "CONFIG_NAME", "COEFF_NAME",
-    "UPDATER_NAME", "STATE_NAME", "NORMALIZER_NAME",
+    "load_weights", "read_meta", "guess_model", "META_NAME", "CONFIG_NAME",
+    "COEFF_NAME", "UPDATER_NAME", "STATE_NAME", "NORMALIZER_NAME",
 ]
 
 
@@ -233,6 +233,29 @@ def restore_into(model, path, load_updater=True):
             model.init()
         _load_state_into(model, z, path, meta, load_updater)
     return model
+
+
+def load_weights(model, path):
+    """Read just the ``(params, state)`` tensors from a checkpoint zip,
+    unflattened against ``model``'s own pytree structure — the hot-swap
+    loader. The configuration inside the zip is deliberately IGNORED: only
+    the flattened array paths matter, so a transfer-learning head-only
+    checkpoint (whose FrozenLayer wrappers preserve the inner layers' param
+    paths) loads cleanly into the plain serving net. Counters, updater state
+    and the model object itself are untouched. A checkpoint whose arrays do
+    not cover the model's structure raises ``WeightSwapError`` (the serving
+    engines additionally verify shapes/dtypes before swapping)."""
+    from deeplearning4j_tpu.resilience.errors import WeightSwapError
+    with _open_zip(path) as z:
+        try:
+            params = _unflatten_into(model.params,
+                                     _loadz(z, path, COEFF_NAME))
+            state = _unflatten_into(model.state, _loadz(z, path, STATE_NAME))
+        except KeyError as e:
+            raise WeightSwapError(
+                f"checkpoint {os.fspath(path)} is not swap-compatible with "
+                f"the serving model", [str(e.args[0])]) from e
+    return params, state
 
 
 def read_meta(path) -> dict:

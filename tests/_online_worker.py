@@ -1,0 +1,118 @@
+"""Online-learning victim for the SIGKILL chaos test (not a pytest module).
+
+Runs the full online loop — stream → guarded fine-tune → checkpoint →
+gate → promote over HTTP into the PARENT's serving server — and kills
+itself at the two nastiest instants:
+
+- ``--kill-after-saves K``  — SIGKILL the instant the K-th checkpoint
+  save returns (mid-fine-tune: manifest just rotated, no promotion yet);
+- ``--kill-at-promotion``   — SIGKILL from the Deployer's
+  ``chaos_mid_promotion`` hook, i.e. after the serving target swapped but
+  before the deploy intent file says ``live`` (mid-promotion).
+
+A relaunch without kill flags must resume from the manifest
+(``trainer.resume``), converge the deploy state (``deployer.recover``)
+and finish its rounds — while the parent's server keeps answering
+/predict the whole time, never on a torn model.
+
+Usage: _online_worker.py --dir D --server-url URL --rounds N
+                         [--kill-after-saves K] [--kill-at-promotion]
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_xla = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla:
+    os.environ["XLA_FLAGS"] = (
+        _xla + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _self_kill():
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dir", required=True)
+    parser.add_argument("--server-url", required=True)
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--kill-after-saves", type=int, default=0)
+    parser.add_argument("--kill-at-promotion", action="store_true")
+    parser.add_argument("--phase", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
+    setup_compile_cache()       # relaunches must not re-pay XLA compiles
+
+    from deeplearning4j_tpu.data.streaming import StreamingDataSetIterator
+    from deeplearning4j_tpu.online import (BatchGuard, Deployer,
+                                           DriftingProblem, HttpTarget,
+                                           OnlineLearningService,
+                                           OnlineTrainer, PromotionGate)
+    from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.serving.replica import build_model
+
+    prob = DriftingProblem()
+    net = build_model("mlp")
+    scratch = build_model("mlp")
+    mgr = CheckpointManager(os.path.join(args.dir, "ck"), keep_last=3)
+    it = StreamingDataSetIterator(batch_size=16)
+    trainer = OnlineTrainer(net, it, mgr, guard=BatchGuard(net),
+                            batches_per_round=4)
+    resumed = trainer.resume()
+    print(f"WORKER_RESUMED from={resumed}", flush=True)
+
+    if args.kill_after_saves > 0:
+        real_save = mgr.save
+        count = [0]
+
+        def killing_save(model, normalizer=None):
+            path = real_save(model, normalizer=normalizer)
+            count[0] += 1
+            if count[0] >= args.kill_after_saves:
+                print("WORKER_SELF_KILL after_save", flush=True)
+                sys.stdout.flush()
+                _self_kill()
+            return path
+        mgr.save = killing_save
+
+    ex, ey = prob.eval_set(128, phase=args.phase)
+    # a permissive quality bar: the chaos test is about crash recovery,
+    # not gate selectivity — promotions must actually happen to be killed
+    gate = PromotionGate(ex, ey, min_improvement=-1.0)
+    chaos = None
+    if args.kill_at_promotion:
+        def chaos():
+            print("WORKER_SELF_KILL mid_promotion", flush=True)
+            sys.stdout.flush()
+            _self_kill()
+    dep = Deployer(mgr, targets=[HttpTarget(args.server_url)],
+                   state_path=os.path.join(args.dir, "deploy.json"),
+                   chaos_mid_promotion=chaos)
+    outcome = dep.recover()
+    print(f"WORKER_RECOVERED outcome={outcome}", flush=True)
+    svc = OnlineLearningService(trainer, gate, dep, scratch,
+                                regression_margin=1.0)
+
+    # batch seeds continue from the restored iteration counter so a
+    # resumed worker trains on fresh data, not a replay of the same rows
+    seed = int(net.iteration) + 1
+    for rnd in range(args.rounds):
+        for _ in range(trainer.batches_per_round):
+            x, y = prob.batch(16, phase=args.phase, seed=seed)
+            seed += 1
+            it.push(x, y, batched=True)
+        out = svc.step()
+        print(f"WORKER_ROUND {rnd} trained={out['trained']} "
+              f"promoted={out['promoted']} version={out['version']}",
+              flush=True)
+    print("WORKER_DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

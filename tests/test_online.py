@@ -1,0 +1,736 @@
+"""Online learning loop: hot swap, promotion gate, rollback, chaos.
+
+The load-bearing claims pinned here:
+- ``CheckpointManager.pin`` makes a checkpoint outlive ``keep_last``
+  rotation, survives a manifest round-trip, and ``unpin`` re-enters it
+  into rotation immediately;
+- swapping same-shape weights into a WARMED InferenceEngine or
+  DecodeEngine performs ZERO new XLA compiles (``trace_count``
+  unchanged), while a shape/dtype/structure-mismatched pytree is
+  rejected with a structured ``WeightSwapError`` BEFORE any engine state
+  changes (outputs stay bitwise identical);
+- a generation in flight across a DecodeEngine swap finishes entirely on
+  the OLD weights; the next request runs on the new ones — still one
+  compiled program;
+- ``POST /admin/swap`` swaps a live server from a checkpoint path (409 on
+  incompatible, 400 on torn/missing) and /predict responses carry
+  ``x-model-version`` — which the Router forwards;
+- the BatchGuard quarantines NaN and loss-spike batches (counted, never
+  crashing); a stalled stream degrades /healthz instead of killing the
+  service, and recovers;
+- the Deployer's promote → rollback restores the pinned incumbent
+  BITWISE under a fresh monotonic version, and ``recover()`` converges a
+  mid-promotion crash (torn or intact candidate) onto one model;
+- slow: ≥3 promotions under live HTTP traffic with zero failed requests
+  and zero new compiles, then a forced regression that auto-rolls back;
+  and a SIGKILL chaos run (mid-fine-tune + mid-promotion) that resumes
+  from the manifest while the serving tier never sees a torn model.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.kafka import InMemoryBroker, NDArrayPublisher, \
+    NDArrayPubSubRoute
+from deeplearning4j_tpu.data.streaming import StreamingDataSetIterator
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.online import (BatchGuard, Deployer, DriftingProblem,
+                                       EngineTarget, OnlineLearningService,
+                                       OnlineTrainer, PromotionGate,
+                                       ServerTarget, TrafficMirror)
+from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
+from deeplearning4j_tpu.resilience.errors import (StreamStalledError,
+                                                  WeightSwapError)
+from deeplearning4j_tpu.resilience.faults import SimulatedCrash
+from deeplearning4j_tpu.serving import (DecodeEngine, InferenceClient,
+                                        InferenceEngine, InferenceServer,
+                                        generate_naive)
+from deeplearning4j_tpu.serving.replica import build_model
+from deeplearning4j_tpu.serving.router import Router
+from deeplearning4j_tpu.util import model_serializer
+
+_WORKER = Path(__file__).with_name("_online_worker.py")
+
+PROB = DriftingProblem()
+
+
+def _mlp(seed=42):
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lstm(seed=7):
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(LSTM(n_out=16, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=13, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(13))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _save_at(mgr, net, iteration):
+    """Record a checkpoint at a chosen iteration number (the manager names
+    and indexes entries by the model's counters)."""
+    net.iteration = iteration
+    return mgr.save(net)
+
+
+def _counter_value(name, **labels):
+    fam = get_registry()._families.get(name)
+    if fam is None:
+        return 0.0
+    if not fam.labelnames:
+        return fam.value
+    want = tuple(str(labels[k]) for k in fam.labelnames)
+    for key, child in fam.children():
+        if key == want:
+            return child.value
+    return 0.0
+
+
+X_PROBE = np.arange(20, dtype=np.float32).reshape(5, 4) / 10.0
+
+
+# -------------------------------------------------------------- pin / unpin
+
+def test_pin_survives_rotation_and_manifest_roundtrip(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    net = _mlp()
+    first = _save_at(mgr, net, 1)
+    mgr.pin(1)
+    for it in range(2, 8):
+        _save_at(mgr, net, it)
+    # the pinned checkpoint outlived six rotations of a keep_last=2 window
+    assert os.path.exists(first)
+    live = {c.iteration: c.pinned for c in mgr.checkpoints()}
+    assert live[1] is True
+    assert set(live) == {1, 6, 7}
+    # manifest round-trip: a fresh manager (new process) sees the pin
+    mgr2 = CheckpointManager(tmp_path, keep_last=2)
+    assert {c.iteration: c.pinned for c in mgr2.checkpoints()}[1] is True
+    # unpin → immediately re-enters rotation and is rotated away (it is
+    # far outside the keep_last window)
+    mgr2.unpin(1)
+    assert not os.path.exists(first)
+    assert {c.iteration for c in mgr2.checkpoints()} == {6, 7}
+
+
+def test_pin_unknown_iteration_raises(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    _save_at(mgr, _mlp(), 5)
+    with pytest.raises(ValueError, match="live iterations"):
+        mgr.pin(99)
+    mgr.pin(5)
+    mgr.pin(5)          # idempotent
+
+
+# ------------------------------------------------- zero-compile weight swap
+
+def test_engine_swap_zero_new_compiles_and_versions():
+    serving, donor = _mlp(seed=42), _mlp(seed=11)
+    eng = InferenceEngine(serving, max_batch=16)
+    eng.warmup((4,), max_batch=16)
+    warm = eng.trace_count
+    before = np.asarray(eng.predict_host(X_PROBE))
+    assert eng.model_version == 0
+
+    v = eng.swap_weights(donor.params, donor.state)
+    after = np.asarray(eng.predict_host(X_PROBE))
+    assert v == 1 and eng.model_version == 1
+    assert eng.trace_count == warm, "hot swap must not trace new programs"
+    assert not np.array_equal(before, after), "swap must change outputs"
+    # donor-derived reference: swapped engine serves the donor's function
+    assert np.allclose(after, np.asarray(donor.output(X_PROBE)),
+                       atol=0, rtol=0)
+    assert eng.stats()["model_version"] == 1
+
+
+def test_engine_swap_mismatch_rejected_before_state_changes():
+    serving = _mlp()
+    eng = InferenceEngine(serving, max_batch=16)
+    eng.warmup((4,), max_batch=16)
+    baseline = np.asarray(eng.predict_host(X_PROBE))
+    good = serving.params
+
+    # shape mismatch (a wider hidden layer)
+    import jax
+    wide = jax.tree_util.tree_map(
+        lambda a: np.zeros((a.shape[0], 32), a.dtype)
+        if getattr(a, "shape", ())[-1:] == (16,) else np.asarray(a), good)
+    with pytest.raises(WeightSwapError, match="expected"):
+        eng.swap_weights(wide)
+
+    # dtype mismatch
+    halved = jax.tree_util.tree_map(
+        lambda a: np.asarray(a, np.float16), good)
+    with pytest.raises(WeightSwapError, match="float16"):
+        eng.swap_weights(halved)
+
+    # structure mismatch (missing layer: params is a per-layer list)
+    with pytest.raises(WeightSwapError, match="missing array"):
+        eng.swap_weights(list(good)[:-1])
+
+    # the live engine was never touched: same version, bitwise outputs
+    assert eng.model_version == 0
+    assert np.array_equal(baseline, np.asarray(eng.predict_host(X_PROBE)))
+
+
+def test_decode_swap_zero_compiles_and_inflight_finishes_on_old_weights():
+    old, new = _lstm(seed=7), _lstm(seed=23)
+    eng = DecodeEngine(old, slots=2, max_len=48)
+    eng.warmup()            # before start(): the loop thread owns the
+    eng.start()             # decode state once it runs
+    try:
+        warm = eng.trace_count
+        assert warm == 1, "one program covers every schedule"
+        prompt = [1, 2, 3]
+
+        fut = eng.submit(prompt, max_new_tokens=24)
+        # wait until the request holds a slot: a swap staged before
+        # admission would (correctly) pause admission and the generation
+        # would run on the NEW weights — not the scenario under test
+        deadline = time.monotonic() + 10
+        while not any(r is not None for r in eng._slot_reqs):
+            assert time.monotonic() < deadline, "request never admitted"
+            time.sleep(0.001)
+        v = eng.swap_weights(new.params, new.state)   # blocks until applied
+        got = fut.result(timeout=30)
+
+        ref_old = generate_naive(old, prompt, 24, max_len=48)
+        assert got["tokens"] == ref_old["tokens"], \
+            "in-flight generation must finish on the old weights"
+        assert v == 1 and eng.model_version == 1
+
+        got2 = eng.generate(prompt, max_new_tokens=24, timeout=30)
+        ref_new = generate_naive(new, prompt, 24, max_len=48)
+        assert got2["tokens"] == ref_new["tokens"], \
+            "post-swap generation must run on the new weights"
+        assert eng.trace_count == warm, "swap must not trace new programs"
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------- HTTP admin surface
+
+def test_admin_swap_http_and_model_version_header(tmp_path):
+    serving, donor = _mlp(seed=42), _mlp(seed=11)
+    ck_good = str(tmp_path / "good.zip")
+    model_serializer.write_model(donor, ck_good)
+    ck_bad = str(tmp_path / "bad.zip")
+    model_serializer.write_model(_lstm(), ck_bad)
+
+    srv = InferenceServer(serving, port=0, max_latency_ms=1.0)
+    srv.start()
+    cli = InferenceClient(f"http://127.0.0.1:{srv.port}", retries=1)
+    try:
+        srv.engine.warmup((4,), max_batch=srv.engine.max_batch)
+        warm = srv.engine.trace_count
+
+        def predict_version():
+            body = json.dumps(
+                {"ndarray": _b64(X_PROBE)}).encode()
+            st, data, hdrs = cli.post_raw("/predict", body)
+            assert st == 200, data
+            mv = {k.lower(): v for k, v in hdrs.items()}["x-model-version"]
+            return int(mv), _from_b64(json.loads(data)["ndarray"])
+
+        v0, out0 = predict_version()
+        assert v0 == 0
+
+        st, data, _ = cli.post_raw("/admin/swap", json.dumps(
+            {"checkpoint": ck_good}).encode())
+        assert st == 200, data
+        rep = json.loads(data)
+        assert rep["swapped"] and rep["version"] == 1
+        assert rep["compiled_programs"] == warm
+
+        v1, out1 = predict_version()
+        assert v1 == 1
+        assert not np.array_equal(out0, out1)
+        assert srv.engine.trace_count == warm
+
+        # incompatible architecture → 409, engine untouched
+        st, data, _ = cli.post_raw("/admin/swap", json.dumps(
+            {"checkpoint": ck_bad}).encode())
+        assert st == 409, data
+        assert json.loads(data)["error"]["type"] == "weight_mismatch"
+        assert predict_version()[0] == 1
+
+        # missing checkpoint → 400
+        st, data, _ = cli.post_raw("/admin/swap", json.dumps(
+            {"checkpoint": str(tmp_path / "nope.zip")}).encode())
+        assert st == 400, data
+        assert json.loads(data)["error"]["type"] == "bad_checkpoint"
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def _b64(a):
+    from deeplearning4j_tpu.clustering.knn_server import ndarray_to_b64
+    return ndarray_to_b64(np.asarray(a))
+
+
+def _from_b64(o):
+    from deeplearning4j_tpu.clustering.knn_server import ndarray_from_b64
+    return ndarray_from_b64(o)
+
+
+def test_router_forwards_model_version_header(tmp_path):
+    from deeplearning4j_tpu.serving.replica import InProcessReplica
+    donor = _mlp(seed=11)
+    ck = str(tmp_path / "donor.zip")
+    model_serializer.write_model(donor, ck)
+    rep = InProcessReplica(model="mlp", chaos=False).start()
+    router = Router([rep.url], port=0, probe_interval=None).start()
+    cli = InferenceClient(f"http://127.0.0.1:{router.port}", retries=1)
+    try:
+        rep.srv.swap_checkpoint(ck)
+        body = json.dumps({"ndarray": _b64(X_PROBE)}).encode()
+        st, data, hdrs = cli.post_raw("/predict", body)
+        assert st == 200, data
+        low = {k.lower(): v for k, v in hdrs.items()}
+        assert low.get("x-model-version") == "1", \
+            "router must forward the replica's model-version header"
+    finally:
+        cli.close()
+        router.stop()
+        rep.stop()
+
+
+# -------------------------------------------------------------- guardrails
+
+def test_guard_quarantines_nan_and_loss_spike():
+    net = _mlp()
+    guard = BatchGuard(net, spike_factor=3.0, warmup=2)
+    base = _counter_value("dl4jtpu_online_quarantined_batches_total",
+                          reason="non_finite")
+    x, y = PROB.batch(16, phase=0, seed=0)
+
+    bad = x.copy()
+    bad[3, 1] = np.nan
+    assert guard.check(bad, y) == "non_finite"
+    assert _counter_value("dl4jtpu_online_quarantined_batches_total",
+                          reason="non_finite") == base + 1
+
+    for seed in range(4):                 # establish the EMA baseline
+        cx, cy = PROB.batch(16, phase=0, seed=seed)
+        assert guard.check(cx, cy) is None
+
+    # saturating features + adversarial labels → loss far above the EMA
+    sx, sy = PROB.batch(16, phase=0, seed=50)
+    spike_x = sx * 50.0
+    spike_y = np.roll(sy, 1, axis=1)
+    assert guard.check(spike_x, spike_y) == "loss_spike"
+
+    # quarantine never touched the weights: clean batches still pass
+    cx, cy = PROB.batch(16, phase=0, seed=60)
+    assert guard.check(cx, cy) is None
+
+
+def test_stream_stall_degrades_health_then_recovers(tmp_path):
+    net = _mlp()
+    it = StreamingDataSetIterator(batch_size=16, stall_timeout=0.2)
+    trainer = OnlineTrainer(net, it, CheckpointManager(tmp_path),
+                            batches_per_round=2)
+    srv = InferenceServer(net, port=0, health_hook=trainer.health_info)
+    # silent stream → the round ends stalled instead of raising
+    assert trainer.run_round() is None
+    assert trainer.stalled
+    assert srv.health_info() == {"status": "degraded",
+                                 "reason": "stream_stalled"}
+    # stream comes back → next round trains and health recovers
+    x, y = PROB.batch(32, phase=0, seed=1)
+    it.push(x, y, batched=True)
+    assert trainer.run_round() is not None
+    assert not trainer.stalled
+    assert srv.health_info()["status"] == "ok"
+
+
+def test_kafka_route_stall_timeout_passthrough():
+    broker = InMemoryBroker()
+    route = NDArrayPubSubRoute(broker, "t", batch_size=2, stall_timeout=0.2)
+    with pytest.raises(StreamStalledError):
+        next(route.iterator)
+    # the stalled iterator stays usable once records arrive
+    pub = NDArrayPublisher(broker, "t")
+    PROB.publish(pub, 2, phase=0, seed=0)
+    route.start()
+    try:
+        ds = next(route.iterator)
+        assert ds.features.shape == (2, 4)
+    finally:
+        route.stop()
+
+
+# ---------------------------------------------------------------- the gate
+
+def test_promotion_gate_decisions():
+    ex, ey = PROB.eval_set(128, phase=0)
+    perfect = lambda x: np.eye(3, dtype=np.float32)[  # noqa: E731
+        np.argmax(x @ PROB.weights(0), axis=1)]
+    rng = np.random.default_rng(0)
+    noisy = lambda x: rng.random((x.shape[0], 3))     # noqa: E731
+
+    gate = PromotionGate(ex, ey, min_improvement=0.0,
+                         max_shadow_disagreement=0.5)
+    # bootstrap: no incumbent → promote
+    d = gate.decide(perfect, None)
+    assert d.promote and "bootstrap" in d.reason
+
+    # clear winner promotes; clear loser is rejected
+    assert gate.decide(perfect, noisy).promote
+    d = gate.decide(noisy, perfect)
+    assert not d.promote and "quality bar" in d.reason
+
+    # shadow-disagreement ceiling blocks even a quality-equal candidate
+    mirror = TrafficMirror()
+    mirror.record(PROB.batch(32, phase=0, seed=3)[0])
+    flipped = lambda x: np.roll(perfect(x), 1, axis=1)  # noqa: E731
+    tight = PromotionGate(ex, ey, min_improvement=-1.0,
+                          max_shadow_disagreement=0.1)
+    d = tight.decide(flipped, perfect, mirror)
+    assert not d.promote and "disagreement" in d.reason
+    assert d.shadow_disagreement > 0.9
+
+
+# ------------------------------------------------------- deploy + rollback
+
+def test_deployer_promote_rollback_bitwise(tmp_path):
+    serving = _mlp(seed=42)
+    eng = InferenceEngine(serving, max_batch=16)
+    eng.warmup((4,), max_batch=16)
+    warm = eng.trace_count
+
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    ck_a = _save_at(mgr, _mlp(seed=11), 1)
+    ck_b = _save_at(mgr, _mlp(seed=12), 2)
+    dep = Deployer(mgr, targets=[EngineTarget(eng)])
+
+    assert dep.promote(ck_a) == 1
+    out_a = np.asarray(eng.predict_host(X_PROBE))
+    assert dep.promote(ck_b) == 2
+    out_b = np.asarray(eng.predict_host(X_PROBE))
+    assert not np.array_equal(out_a, out_b)
+    pins = {c.iteration: c.pinned for c in mgr.checkpoints()}
+    assert pins[1] and pins[2], "current AND rollback target stay pinned"
+
+    v = dep.rollback()
+    assert v == 3, "rollback mints a NEW monotonic version"
+    assert eng.model_version == 3
+    restored = np.asarray(eng.predict_host(X_PROBE))
+    assert np.array_equal(restored, out_a), \
+        "rollback must restore the incumbent bitwise"
+    assert eng.trace_count == warm
+    with pytest.raises(RuntimeError, match="no previous"):
+        dep.rollback()
+    state = json.loads((tmp_path / "deploy.json").read_text())
+    assert state["phase"] == "live" and state["version"] == 3
+
+
+def test_deployer_recovers_mid_promotion_crash(tmp_path):
+    net1, net2 = _mlp(seed=42), _mlp(seed=42)
+    e1 = InferenceEngine(net1, max_batch=16)
+    e2 = InferenceEngine(net2, max_batch=16)
+    mgr = CheckpointManager(tmp_path, keep_last=3)
+    ck_a = _save_at(mgr, _mlp(seed=11), 1)
+    ck_b = _save_at(mgr, _mlp(seed=12), 2)
+
+    targets = [EngineTarget(e1), EngineTarget(e2)]
+    dep = Deployer(mgr, targets=targets)
+    dep.promote(ck_a)
+
+    def crash():
+        raise SimulatedCrash("killed between target swaps")
+    dep.chaos_mid_promotion = crash
+    with pytest.raises(SimulatedCrash):
+        dep.promote(ck_b)
+    # split brain: e1 already swapped to B, e2 still serves A
+    assert e1.model_version == 2 and e2.model_version == 1
+
+    # "restart": a fresh Deployer reads the promoting intent and, with the
+    # candidate zip intact, finishes the promotion on every target
+    dep2 = Deployer(mgr, targets=targets)
+    assert dep2.recover() == "promoted"
+    o1 = np.asarray(e1.predict_host(X_PROBE))
+    o2 = np.asarray(e2.predict_host(X_PROBE))
+    assert np.array_equal(o1, o2), "recover must converge the tier"
+    assert dep2.current["checkpoint"] == ck_b
+
+    # same crash but the candidate zip is TORN → converge back onto the
+    # pinned incumbent instead
+    ck_c = _save_at(mgr, _mlp(seed=13), 3)
+    dep2.chaos_mid_promotion = crash
+    with pytest.raises(SimulatedCrash):
+        dep2.promote(ck_c)
+    with open(ck_c, "r+b") as fh:       # torn zip: truncate mid-archive
+        fh.truncate(100)
+    dep3 = Deployer(mgr, targets=targets)
+    assert dep3.recover() == "reverted"
+    o1 = np.asarray(e1.predict_host(X_PROBE))
+    o2 = np.asarray(e2.predict_host(X_PROBE))
+    assert np.array_equal(o1, o2)
+    assert dep3.current["checkpoint"] == ck_b
+
+
+# ------------------------------------------------------- assembled service
+
+def _stack(tmp_path, engine_targets, batches_per_round=6):
+    net, scratch = build_model("mlp"), build_model("mlp")
+    it = StreamingDataSetIterator(batch_size=16)
+    mgr = CheckpointManager(os.path.join(tmp_path, "ck"), keep_last=3)
+    trainer = OnlineTrainer(net, it, mgr, guard=BatchGuard(net),
+                            batches_per_round=batches_per_round)
+    ex, ey = PROB.eval_set(128, phase=0)
+    gate = PromotionGate(ex, ey, min_improvement=0.0)
+    mirror = TrafficMirror()
+    dep = Deployer(mgr, targets=list(engine_targets))
+    svc = OnlineLearningService(trainer, gate, dep, scratch, mirror=mirror,
+                                regression_margin=0.05)
+    return net, it, trainer, gate, mirror, dep, svc
+
+
+def _feed(it, phase, seeds):
+    for s in seeds:
+        x, y = PROB.batch(16, phase=phase, seed=s)
+        it.push(x, y, batched=True)
+
+
+def test_service_trains_promotes_and_improves(tmp_path):
+    serving = build_model("mlp")
+    eng = InferenceEngine(serving, max_batch=16)
+    eng.warmup((4,), max_batch=16)
+    warm = eng.trace_count
+    net, it, trainer, gate, mirror, dep, svc = _stack(
+        str(tmp_path), [EngineTarget(eng)])
+
+    seed, qualities = 0, []
+    for _ in range(5):
+        _feed(it, 0, range(seed, seed + 6))
+        seed += 6
+        mirror.record(PROB.batch(8, phase=0, seed=5000 + seed)[0])
+        out = svc.step()
+        assert out["trained"]
+        if out["promoted"]:
+            qualities.append(out["decision"]["candidate_quality"])
+    assert len(qualities) >= 2, "expected at least two promotions"
+    assert qualities[-1] > qualities[0], "quality must improve"
+    assert eng.model_version == dep.version >= 2
+    assert eng.trace_count == warm, "no swap may compile anything new"
+
+
+def test_service_forced_regression_rolls_back_bitwise(tmp_path):
+    serving = build_model("mlp")
+    eng = InferenceEngine(serving, max_batch=16)
+    eng.warmup((4,), max_batch=16)
+    net, it, trainer, gate, mirror, dep, svc = _stack(
+        str(tmp_path), [EngineTarget(eng)])
+
+    _feed(it, 0, range(6))
+    out = svc.step()
+    assert out["promoted"] and not out["rolled_back"]
+    v_good = out["version"]
+    incumbent_out = np.asarray(eng.predict_host(X_PROBE))
+
+    # force a bad candidate through the gate: mislabeled training tanks
+    # quality, min_improvement=-inf promotes it anyway — the regression
+    # watch must catch it and roll back. The BatchGuard would (correctly)
+    # quarantine this poison, so it is disabled for the forced run.
+    gate.min_improvement = -1e9
+    svc.regression_margin = 0.02
+    trainer.guard = None
+    trainer.batches_per_round = 12
+    for s in range(100, 112):
+        x, y = PROB.batch(16, phase=0, seed=s)
+        it.push(x, np.roll(y, 1, axis=1), batched=True)
+    out = svc.step()
+    assert out["promoted"] and out["rolled_back"], out
+    assert out["version"] == v_good + 2, "promote + rollback, both versioned"
+    assert np.array_equal(np.asarray(eng.predict_host(X_PROBE)),
+                          incumbent_out), \
+        "rollback must restore the incumbent outputs bitwise"
+
+
+# ------------------------------------------------------------------- slow
+
+@pytest.mark.slow
+def test_online_soak_hot_swaps_under_live_traffic(tmp_path):
+    """≥3 promotions across a drifting stream while live HTTP /predict
+    traffic flows: zero failed requests, zero new compiles per swap,
+    monotonic model versions on the wire, and a forced regression at the
+    end that rolls back bitwise — all through a real server socket."""
+    serving = build_model("mlp")
+    mirror = TrafficMirror()
+    net, it, trainer, gate, _m, dep, svc = _stack(str(tmp_path), [],
+                                                  batches_per_round=8)
+    svc.mirror = mirror
+    srv = InferenceServer(serving, port=0, max_latency_ms=1.0,
+                          health_hook=svc.health_info,
+                          request_mirror=mirror.record)
+    srv.start()
+    dep.targets.append(ServerTarget(srv))
+    srv.engine.warmup((4,), max_batch=srv.engine.max_batch)
+    warm = srv.engine.trace_count
+
+    phase_box = [0]
+    failures, versions = [], []
+    stop = threading.Event()
+
+    def traffic():
+        cli = InferenceClient(f"http://127.0.0.1:{srv.port}", retries=1)
+        rng = np.random.default_rng(99)
+        try:
+            while not stop.is_set():
+                x = PROB.batch(4, phase=phase_box[0],
+                               seed=int(rng.integers(1 << 30)))[0]
+                body = json.dumps({"ndarray": _b64(x)}).encode()
+                try:
+                    st, data, hdrs = cli.post_raw("/predict", body)
+                except Exception as e:      # noqa: BLE001
+                    failures.append(repr(e))
+                    continue
+                if st != 200:
+                    failures.append((st, data[:200]))
+                    continue
+                low = {k.lower(): v for k, v in hdrs.items()}
+                versions.append(int(low["x-model-version"]))
+                out = _from_b64(json.loads(data)["ndarray"])
+                if not np.all(np.isfinite(out)):
+                    failures.append("non-finite prediction")
+                time.sleep(0.002)
+        finally:
+            cli.close()
+
+    th = threading.Thread(target=traffic, daemon=True)
+    th.start()
+    try:
+        promotions, seed = 0, 0
+        for rnd in range(9):
+            phase = rnd // 3
+            if phase != phase_box[0]:
+                phase_box[0] = phase
+                gate.set_eval_set(*PROB.eval_set(256, phase=phase))
+            _feed(it, phase, range(seed, seed + 8))
+            seed += 8
+            out = svc.step()
+            assert out["trained"], out
+            assert not out["rolled_back"], out
+            if out["promoted"]:
+                promotions += 1
+                assert srv.engine.trace_count == warm, \
+                    "swap under traffic must not compile"
+            time.sleep(0.3)     # let live traffic observe this version
+        assert promotions >= 3, f"only {promotions} promotions"
+        assert dep.version == promotions
+
+        # forced regression over the same live tier (guard off — it would
+        # rightly quarantine the poison this block trains on)
+        pre = np.asarray(srv.engine.predict_host(X_PROBE))
+        gate.min_improvement = -1e9
+        svc.regression_margin = 0.02
+        trainer.guard = None
+        trainer.batches_per_round = 12
+        for s in range(5000, 5012):
+            x, y = PROB.batch(16, phase=phase_box[0], seed=s)
+            it.push(x, np.roll(y, 1, axis=1), batched=True)
+        out = svc.step()
+        assert out["promoted"] and out["rolled_back"], out
+        assert np.array_equal(pre,
+                              np.asarray(srv.engine.predict_host(X_PROBE)))
+    finally:
+        stop.set()
+        th.join(timeout=30)
+        srv.stop()
+
+    assert not failures, f"{len(failures)} failed requests: {failures[:5]}"
+    assert len(versions) > 30, "traffic thread barely ran"
+    assert versions == sorted(versions), \
+        "model versions on the wire must be monotonic"
+    assert versions[-1] >= 3, "traffic never saw the swaps land"
+    assert mirror.seen > 0, "live traffic must reach the shadow mirror"
+
+
+@pytest.mark.slow
+def test_online_trainer_sigkill_chaos(tmp_path):
+    """SIGKILL the online trainer mid-fine-tune and mid-promotion; each
+    relaunch resumes from the manifest and converges the deploy intent;
+    the parent's serving server answers correctly throughout."""
+    serving = build_model("mlp")
+    srv = InferenceServer(serving, port=0, max_latency_ms=1.0)
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}"
+    cli = InferenceClient(url, retries=1)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (str(_WORKER.parent.parent) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+
+    def probe():
+        out = cli.predict(X_PROBE)
+        assert out.shape == (5, 3) and np.all(np.isfinite(out))
+        return np.asarray(out)
+
+    def run(*extra):
+        cmd = [sys.executable, str(_WORKER), "--dir", str(tmp_path),
+               "--server-url", url, *extra]
+        return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=600)
+
+    try:
+        probe()
+        # 1: die right after the 2nd checkpoint save (mid-fine-tune)
+        r1 = run("--rounds", "10", "--kill-after-saves", "2")
+        assert r1.returncode == -9, (r1.returncode, r1.stdout, r1.stderr)
+        assert "WORKER_SELF_KILL after_save" in r1.stdout
+        mgr = CheckpointManager(tmp_path / "ck", keep_last=3)
+        assert mgr.latest() is not None, "no checkpoint survived the kill"
+        probe()
+
+        # 2: die mid-promotion — after the serving target swapped, before
+        # the intent file says live
+        r2 = run("--rounds", "2", "--kill-at-promotion")
+        assert r2.returncode == -9, (r2.returncode, r2.stdout, r2.stderr)
+        assert "WORKER_SELF_KILL mid_promotion" in r2.stdout
+        state = json.loads((tmp_path / "deploy.json").read_text())
+        assert state["phase"] == "promoting"
+        probe()     # server still serves (already-swapped weights are fine)
+
+        # 3: clean relaunch resumes from the manifest, converges the
+        # promotion, and finishes its rounds
+        r3 = run("--rounds", "3")
+        assert r3.returncode == 0, (r3.returncode, r3.stdout, r3.stderr)
+        assert "WORKER_RESUMED from=" in r3.stdout
+        assert "from=None" not in r3.stdout, "must resume, not start fresh"
+        assert "WORKER_RECOVERED outcome=promoted" in r3.stdout
+        assert "WORKER_DONE" in r3.stdout
+        state = json.loads((tmp_path / "deploy.json").read_text())
+        assert state["phase"] == "live"
+        assert srv.engine.model_version >= 1
+        probe()
+    finally:
+        cli.close()
+        srv.stop()
