@@ -1,0 +1,27 @@
+"""The execution core: mesh + executor + kernel routing.
+
+Every compile site in the repo — train step and ``fit_scan`` in both
+model containers, the bucketed serving forward, the continuous-batching
+decode step — builds its XLA programs through ``Executor.jit`` against
+the ONE process mesh (``data``/``model`` axes). See docs/SHARDING.md.
+"""
+
+from deeplearning4j_tpu.exec.mesh import (DATA_AXIS, MODEL_AXIS,  # noqa: F401
+                                          build_mesh, default_mesh,
+                                          set_default_mesh,
+                                          host_device_env)
+from deeplearning4j_tpu.exec.executor import (Executor,  # noqa: F401
+                                              get_executor, set_executor,
+                                              param_spec,
+                                              PARAMS, STATE, OPT, REPL,
+                                              BATCH, STEP_BATCH, SLOTS)
+from deeplearning4j_tpu.exec.routing import (lstm_fwd_route,  # noqa: F401
+                                             set_route, load_measurements)
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "build_mesh", "default_mesh",
+    "set_default_mesh", "host_device_env",
+    "Executor", "get_executor", "set_executor", "param_spec",
+    "PARAMS", "STATE", "OPT", "REPL", "BATCH", "STEP_BATCH", "SLOTS",
+    "lstm_fwd_route", "set_route", "load_measurements",
+]

@@ -1,0 +1,96 @@
+"""Shape-keyed kernel-vs-scan routing (data-driven, overridable).
+
+The fused-LSTM Pallas kernel does not win everywhere: KERNELS_TPU.json
+(bench_kernels, v5e) shows the forward LOSING to XLA's scan codegen at
+small ``B*H`` (latency-bound — (4,16,8) runs at 0.1x) and on two shapes
+the old ``B*H >= 2048`` heuristic routed to Pallas anyway:
+
+    (16, 64, 128, float32)  fwd 0.96x   — crossover shape, scan wins
+    (32, 128, 256, float32) fwd 0.72x   — long-T f32: double-width
+                                          streams, scan pipelines better
+
+This module owns the routing decision per (backend, kernel, phase,
+shape): exact measured shapes first (the table below is distilled from
+KERNELS_TPU.json and can be re-derived with ``load_measurements``),
+then the measured heuristic for everything in between. The backward
+kernel wins at every validated shape, so only the forward routes.
+
+Overrides, strongest first:
+
+1. ``set_route("fused_lstm", "pallas"|"scan"|None)`` — programmatic pin
+2. ``DL4JTPU_LSTM_FWD_ROUTE=pallas|scan`` — environment pin
+3. measured per-shape table (exact (B, T, H, dtype) match)
+4. heuristic: scan when ``B*H < 2048``; f32 additionally needs
+   ``B*H > 2048`` and ``T < 128`` (both measured losses above sit on
+   those boundaries); otherwise pallas
+"""
+
+import os
+from typing import Optional
+
+# exact measured rows where the decision differs per shape — distilled
+# from KERNELS_TPU.json (only rows the heuristic alone would misroute
+# need listing; kept small and human-auditable on purpose)
+_MEASURED = {
+    # (kernel, B, T, H, dtype) -> route        measured fwd speedup
+    ("fused_lstm", 16, 64, 128, "float32"): "scan",     # 0.96x
+    ("fused_lstm", 16, 64, 128, "bfloat16"): "pallas",  # 1.23x
+    ("fused_lstm", 32, 128, 256, "float32"): "scan",    # 0.72x
+    ("fused_lstm", 32, 128, 256, "bfloat16"): "pallas",  # 1.23x
+    ("fused_lstm", 32, 64, 256, "float32"): "pallas",   # 1.19x
+    ("fused_lstm", 64, 32, 512, "float32"): "pallas",   # 1.07x
+}
+
+# measured latency/bandwidth crossover (see ops/lstm_pallas.py docstring)
+_MIN_BH = 2048
+
+_forced: Optional[str] = None
+
+
+def set_route(kernel: str, route: Optional[str]) -> None:
+    """Pin every ``kernel`` forward to ``route`` ('pallas'/'scan'), or
+    None to restore data-driven routing. Test/debug hook."""
+    global _forced
+    if route not in (None, "pallas", "scan"):
+        raise ValueError(f"route must be pallas/scan/None, got {route!r}")
+    _forced = route
+
+
+def load_measurements(results, kernel: str = "fused_lstm") -> int:
+    """Merge bench rows (KERNELS_TPU.json ``results`` schema) into the
+    table: a row routes to pallas iff its measured ``fwd_speedup`` > 1.
+    Returns the number of rows absorbed."""
+    n = 0
+    for row in results:
+        if row.get("kernel") != kernel or row.get("fwd_speedup") is None:
+            continue
+        key = (kernel, row["B"], row["T"], row["H"], row["dtype"])
+        _MEASURED[key] = "pallas" if row["fwd_speedup"] > 1 else "scan"
+        n += 1
+    return n
+
+
+def lstm_fwd_route(b: int, h: int, t: Optional[int] = None,
+                   dtype: Optional[str] = None,
+                   backend: Optional[str] = None) -> str:
+    """Route the fused-LSTM forward for one shape: 'pallas' or 'scan'.
+
+    ``backend`` other than TPU always scans (the kernel only compiles
+    for Mosaic; CPU/interpret callers gate on that before asking)."""
+    if _forced is not None:
+        return _forced
+    env = os.environ.get("DL4JTPU_LSTM_FWD_ROUTE", "").strip().lower()
+    if env in ("pallas", "scan"):
+        return env
+    if backend is not None and backend != "tpu":
+        return "scan"
+    if t is not None and dtype is not None:
+        hit = _MEASURED.get(("fused_lstm", b, t, h, str(dtype)))
+        if hit is not None:
+            return hit
+    if b * h < _MIN_BH:
+        return "scan"
+    if str(dtype) == "float32" and (b * h <= _MIN_BH
+                                    or (t is not None and t >= 128)):
+        return "scan"
+    return "pallas"

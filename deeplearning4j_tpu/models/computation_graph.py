@@ -57,6 +57,16 @@ class ComputationGraph:
         self._transforms = None
         self._compile_count = 0       # train programs traced (see _note_compile)
         self._train_mon = None        # lazy TrainMonitor (metric children)
+        self._exec = None             # execution core (lazy; exec/executor.py)
+
+    @property
+    def _executor(self):
+        """The execution core all compile sites build programs through
+        (mesh placement, in/out shardings, donation — docs/SHARDING.md)."""
+        if self._exec is None:
+            from deeplearning4j_tpu.exec import get_executor
+            self._exec = get_executor()
+        return self._exec
 
     # ------------------------------------------------------------------ init
     def init(self, rng=None):
@@ -277,7 +287,13 @@ class ComputationGraph:
             new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
             return new_params, new_state, new_opt, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        from deeplearning4j_tpu import exec as ex
+        return self._executor.jit(
+            step,
+            in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.BATCH, ex.BATCH,
+                      ex.REPL, ex.BATCH, ex.BATCH),
+            out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL),
+            donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------- fit
     def fit_scan(self, inputs_steps, labels_steps):
@@ -318,7 +334,13 @@ class ComputationGraph:
                     body, (params, state, opt_state, it0), (xs, ys))
                 return p, s, o, losses
 
-            self._scan_fit = jax.jit(inner, donate_argnums=(0, 1, 2))
+            from deeplearning4j_tpu import exec as ex
+            self._scan_fit = self._executor.jit(
+                inner,
+                in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.STEP_BATCH,
+                          ex.STEP_BATCH, ex.REPL),
+                out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL),
+                donate_argnums=(0, 1, 2))
         c0, t0 = self._compile_count, time.perf_counter()
         self.params, self.state, self.opt_state, losses = self._scan_fit(
             self.params, self.state, self.opt_state, inputs_steps,
@@ -642,7 +664,13 @@ class ComputationGraph:
                                                          grads)
             return new_params, new_state, new_opt, loss, new_carries
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        from deeplearning4j_tpu import exec as ex
+        return self._executor.jit(
+            step,
+            in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.BATCH, ex.BATCH,
+                      ex.REPL, ex.BATCH, ex.BATCH, ex.BATCH),
+            out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL, ex.BATCH),
+            donate_argnums=(0, 1, 2))
 
     def _fit_tbptt(self, inputs, labels, masks, label_masks):
         """Truncated BPTT over the graph: slice time into tbptt_fwd_length
@@ -699,7 +727,10 @@ class ComputationGraph:
                 acts, _, _ = self._forward(params, state, inputs, train=False,
                                            rng=None)
                 return [acts[n] for n in self.conf.network_outputs]
-            self._output_fn = jax.jit(fwd)
+            from deeplearning4j_tpu import exec as ex
+            self._output_fn = self._executor.jit(
+                fwd, in_specs=(ex.PARAMS, ex.STATE, ex.BATCH),
+                out_specs=(ex.BATCH,))
         outs = self._output_fn(self.params, self.state, inputs)
         return outs[0] if len(outs) == 1 else outs
 

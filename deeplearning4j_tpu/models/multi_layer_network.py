@@ -63,6 +63,16 @@ class MultiLayerNetwork:
         self._transforms = None
         self._compile_count = 0       # train programs traced (see _note_compile)
         self._train_mon = None        # lazy TrainMonitor (metric children)
+        self._exec = None             # execution core (lazy; exec/executor.py)
+
+    @property
+    def _executor(self):
+        """The execution core all compile sites build programs through
+        (mesh placement, in/out shardings, donation — docs/SHARDING.md)."""
+        if self._exec is None:
+            from deeplearning4j_tpu.exec import get_executor
+            self._exec = get_executor()
+        return self._exec
 
     # ------------------------------------------------------------------ init
     def init(self, rng=None):
@@ -256,7 +266,13 @@ class MultiLayerNetwork:
             new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
             return new_params, new_state, new_opt, loss, new_carries
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        from deeplearning4j_tpu import exec as ex
+        return self._executor.jit(
+            step,
+            in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.BATCH, ex.BATCH,
+                      ex.REPL, ex.BATCH, ex.BATCH, ex.BATCH),
+            out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL, ex.BATCH),
+            donate_argnums=(0, 1, 2))
 
     def _get_train_step(self, with_masks, with_carries):
         key = (with_masks, with_carries)
@@ -304,7 +320,13 @@ class MultiLayerNetwork:
                     body, (params, state, opt_state, it0), (xs, ys))
                 return p, s, o, losses
 
-            self._scan_fit = jax.jit(inner, donate_argnums=(0, 1, 2))
+            from deeplearning4j_tpu import exec as ex
+            self._scan_fit = self._executor.jit(
+                inner,
+                in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.STEP_BATCH,
+                          ex.STEP_BATCH, ex.REPL),
+                out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL),
+                donate_argnums=(0, 1, 2))
         c0, t0 = self._compile_count, time.perf_counter()
         self.params, self.state, self.opt_state, losses = self._scan_fit(
             self.params, self.state, self.opt_state, xs, ys,
@@ -713,7 +735,10 @@ class MultiLayerNetwork:
                 act, _, _ = self._forward(params, state, x, train=False,
                                           rng=None, mask=mask)
                 return act
-            self._output_fn = jax.jit(fwd)
+            from deeplearning4j_tpu import exec as ex
+            self._output_fn = self._executor.jit(
+                fwd, in_specs=(ex.PARAMS, ex.STATE, ex.BATCH, ex.BATCH),
+                out_specs=(ex.BATCH,))
         return self._output_fn(self.params, self.state, x,
                                None if mask is None else jnp.asarray(mask))
 

@@ -211,7 +211,7 @@ def lstm_pair_fusable(l1, l2, p1, p2, x, mask):
         return False
     interp = ops.interpret_mode()
     return supported2(B, T, l1.n_out, jnp.dtype(dt).itemsize, interp) and \
-        (interp or use_pallas_fwd(B, l1.n_out))
+        (interp or use_pallas_fwd(B, l1.n_out, t=T, dtype=jnp.dtype(dt)))
 
 
 def apply_lstm_pair(l1, l2, p1, p2, x, *, train, rng):

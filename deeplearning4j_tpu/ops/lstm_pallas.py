@@ -115,10 +115,16 @@ def supported(b, t, h, itemsize=4, interpret=False):
             + _resident_bytes(b, h, itemsize) <= _VMEM_BUDGET)
 
 
-def use_pallas_fwd(b, h):
+def use_pallas_fwd(b, h, t=None, dtype=None):
     """Forward routing: Pallas when bandwidth-bound, lax.scan when the
-    sequential small-GEMM chain is latency-bound (see module docstring)."""
-    return b * h >= _PALLAS_FWD_MIN_BH
+    sequential small-GEMM chain is latency-bound. The decision lives in
+    the shape-keyed routing table (exec/routing.py) — measured rows from
+    KERNELS_TPU.json first, the ``B*H >= 2048`` crossover heuristic in
+    between, pinnable via ``DL4JTPU_LSTM_FWD_ROUTE``. Callers that know
+    T and dtype should pass them: two measured f32 shapes route to scan
+    that the bare crossover heuristic would send to Pallas."""
+    from deeplearning4j_tpu.exec.routing import lstm_fwd_route
+    return lstm_fwd_route(b, h, t=t, dtype=dtype) == "pallas"
 
 
 def _cell_math(z, c, H):
@@ -374,7 +380,8 @@ def fused_lstm_sequence(gate_in, rw, h0, c0, interpret=False):
     this halves the inference kernel's write traffic.)
     """
     B, H = h0.shape
-    if not interpret and not use_pallas_fwd(B, H):
+    if not interpret and not use_pallas_fwd(B, H, t=gate_in.shape[0],
+                                            dtype=gate_in.dtype):
         return _scan_fwd(gate_in, rw, h0, c0, save_reserve=False)
     return _fwd_call(gate_in, rw, h0, c0, interpret=interpret,
                      save_reserve=False)
@@ -382,7 +389,8 @@ def fused_lstm_sequence(gate_in, rw, h0, c0, interpret=False):
 
 def _fused_fwd(gate_in, rw, h0, c0, interpret):
     B, H = h0.shape
-    if not interpret and not use_pallas_fwd(B, H):
+    if not interpret and not use_pallas_fwd(B, H, t=gate_in.shape[0],
+                                            dtype=gate_in.dtype):
         hs, tc, cprev, gates, cT = _scan_fwd(gate_in, rw, h0, c0,
                                              save_reserve=True)
     else:

@@ -141,7 +141,8 @@ def validate_lstm_case(b, t, h, dtype="float32", rtol=2e-3, atol=2e-4,
     assert errs["hs"] <= atol + rtol and errs["cT"] <= atol + rtol * 3, errs
 
     res = {"kernel": "fused_lstm", "B": b, "T": t, "H": h, "dtype": dtype,
-           "fwd_route": ("pallas" if lstm_pallas.use_pallas_fwd(b, h)
+           "fwd_route": ("pallas"
+                         if lstm_pallas.use_pallas_fwd(b, h, t=t, dtype=dtype)
                          else "scan"),
            "max_err": round(max(errs.values()), 8)}
     if time_it:

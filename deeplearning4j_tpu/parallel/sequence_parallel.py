@@ -26,7 +26,8 @@ from deeplearning4j_tpu.util.shmap import shard_map
 
 def _ring_attention_local(q, k, v, axis_name, causal):
     """Runs INSIDE shard_map. q/k/v: (B, Tl, H, Dh) local blocks."""
-    n = lax.axis_size(axis_name)
+    # psum of 1 = the axis size (lax.axis_size is gone in this jax line)
+    n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, Tl, H, Dh = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, q.dtype))

@@ -106,38 +106,17 @@ class ParallelWrapper:
 
     # ------------------------------------------------------------------ build
     def _param_sharding(self, leaf, path=""):
-        """TP placement rule (Megatron pairing, expressed as GSPMD
-        annotations — XLA inserts the collectives, correctness never depends
-        on the annotation):
-
-        - column-parallel (shard the OUTPUT/last dim): attention Q/K/V
-          projections (sharding the head dim), FFN up-projections, conv
-          kernels' output channels, generic dense kernels;
-        - row-parallel (shard the INPUT/first dim): the second half of each
-          pair — attention output projection ``Wo`` and FFN down-projections
-          — recognized by parameter path (``Wo``/``ff2``/``down``) or by a
-          wide->narrow shape; the activation then stays sharded through the
-          pair with one all-reduce at the row layer's output;
-        - 1-D vectors (biases, LN gamma/beta): replicated — sharding tiny
-          vectors buys nothing and costs collectives.
-        """
+        """TP placement for one weight leaf. The Megatron pairing rule
+        (column-parallel Q/K/V & up-projections, row-parallel Wo/ff2/down,
+        replicated 1-D vectors) lives in ``exec.param_spec`` — the same
+        rule the execution core applies when its mesh has a model axis, so
+        the wrapper and the default path can never disagree on placement."""
         if self.model_axis is None:
             return NamedSharding(self.mesh, P())
-        ax = self.model_axis
-        m = self.mesh.shape[ax]
-        nd = getattr(leaf, "ndim", 0)
-        if nd >= 2:
-            row_name = any(t in path for t in ("Wo", "ff2", "down"))
-            row_shape = leaf.shape[0] > leaf.shape[-1]
-            if (row_name or (row_shape and not any(
-                    t in path for t in ("Wq", "Wk", "Wv", "ff1", "up")))) \
-                    and leaf.shape[0] % m == 0 and leaf.shape[0] >= m:
-                return NamedSharding(self.mesh,
-                                     P(*([ax] + [None] * (nd - 1))))
-            if leaf.shape[-1] % m == 0 and leaf.shape[-1] >= m:
-                return NamedSharding(self.mesh,
-                                     P(*([None] * (nd - 1) + [ax])))
-        return NamedSharding(self.mesh, P())
+        from deeplearning4j_tpu.exec import param_spec
+        return NamedSharding(self.mesh, param_spec(
+            path, leaf, self.mesh.shape[self.model_axis],
+            axis=self.model_axis))
 
     def _replicated(self, tree):
         """Place params: replicated (pure DP) or TP-sharded (2-D mesh)."""

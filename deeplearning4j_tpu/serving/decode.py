@@ -104,7 +104,14 @@ class DecodeEngine:
         self.vocab = itype.size
         self.warmup_seconds: Optional[float] = None
 
-        self._step = jax.jit(self._step_impl, donate_argnums=(2,))
+        from deeplearning4j_tpu import exec as ex
+        execu = getattr(model, "_executor", None) or ex.get_executor()
+        self._step = execu.jit(
+            self._step_impl,
+            in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS, ex.BATCH, ex.BATCH,
+                      ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH),
+            out_specs=(ex.BATCH, ex.SLOTS),
+            donate_argnums=(2,))
         self._dstate = None
         self._live = None          # (params, state) after the first swap
         self._pending_swap = None  # staged (params, state, version, Event)

@@ -219,7 +219,11 @@ class InferenceEngine:
                                            train=False, rng=None, mask=mask)
                 return [act]
 
-        self._fwd = jax.jit(fwd)
+        from deeplearning4j_tpu import exec as ex
+        execu = getattr(model, "_executor", None) or ex.get_executor()
+        self._fwd = execu.jit(
+            fwd, in_specs=(ex.PARAMS, ex.STATE, ex.BATCH, ex.BATCH),
+            out_specs=(ex.BATCH,))
         return self._fwd
 
     def _note_trace(self, inputs, mask):

@@ -25,8 +25,10 @@ def _x64():
     catastrophically in float32; the reference similarly forces
     DataBuffer.Type.DOUBLE in its gradient-check suites). A process-global
     ``jax.config.update`` would leak x64 defaults into every test imported
-    after this module — the context manager keeps it local."""
-    with jax.enable_x64():
+    after this module — the context manager keeps it local. (Lives under
+    jax.experimental since jax 0.4.31; the top-level alias is gone.)"""
+    from jax.experimental import enable_x64
+    with enable_x64():
         yield
 
 

@@ -28,3 +28,30 @@ try:
     _xb._backend_factories.pop("axon", None)
 except Exception:
     pass
+
+import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "mesh8: needs 8 devices (the forced host-device count above; "
+        "skipped automatically when the process sees fewer)")
+
+
+def pytest_runtest_setup(item):
+    if item.get_closest_marker("mesh8") is not None:
+        import jax as _jax
+        if len(_jax.devices()) < 8:
+            pytest.skip("needs 8 devices "
+                        "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+
+@pytest.fixture
+def mesh8():
+    """Subprocess environment with 8 virtual CPU devices. The host-device
+    flag only takes effect before jax initializes, so tests that need a
+    DIFFERENT device count than this process (or a clean jax) must spawn a
+    child with this env rather than mutate XLA_FLAGS in place."""
+    from deeplearning4j_tpu.exec import host_device_env
+    return host_device_env(8)
