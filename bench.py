@@ -921,6 +921,209 @@ def bench_decode(max_len=256, gen_tokens=128, streams=32):
          "warmup_seconds": round(eng.warmup_seconds, 2)})
 
 
+def bench_quantized(streams=16, gen_tokens=96, fast=False):
+    """Quantized-serving row: the SAME engines at f32 / int8 / fp8
+    (docs/QUANTIZATION.md). Two halves:
+
+    (a) serving QPS + end-to-end eval accuracy on a trained classifier
+        through three ``InferenceEngine``s that differ ONLY in
+        ``precision=`` — the accuracy deltas are ASSERTED against the
+        documented bars (int8 ≤ 0.01, fp8 ≤ 0.02 absolute), not just
+        reported;
+    (b) decode tokens/sec on the charRNN 2xLSTM(256) through
+        ``DecodeEngine`` — int8 weights vs the bf16 compute path. The
+        memory-bound decode step is the int8 win: the weight read per
+        step shrinks 4x vs f32 (2x vs bf16). Asserted: int8 weight
+        bytes ≤ 0.30x f32, ONE compiled decode program per engine, and
+        (full mode only) int8 tokens/sec ≥ 1.2x the bf16 path.
+
+    ``fast=True`` is the tier-1 CI variant (tests/test_bench_rows.py):
+    tiny token/pass counts, f32 stands in for bf16 as the decode
+    baseline, and the timing ratio is reported but not asserted —
+    counts and accuracy bars stay asserted."""
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.quant import record_accuracy_delta, tree_bytes
+    from deeplearning4j_tpu.serving import DecodeEngine, InferenceEngine
+    from deeplearning4j_tpu.zoo.simple import TextGenerationLSTM
+
+    if fast:
+        streams, gen_tokens = 4, 8
+    passes = 1 if fast else 3
+
+    # --- (a) serving: 3-blob classifier, engines differing only in precision
+    rs = np.random.RandomState(31)
+    d, k, n = 8, 3, 240
+    centers = rs.randn(k, d) * 3.0
+    yi = rs.randint(0, k, n)
+    X = (centers[yi] + rs.randn(n, d) * 0.5).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=k, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    onehot = np.eye(k, dtype=np.float32)[yi]
+    for _ in range(15):
+        net.fit(DataSet(X, onehot))
+
+    acc, qps = {}, {}
+    eng_ids = {}
+    for p in ("f32", "int8", "fp8"):
+        eng = InferenceEngine(net, max_batch=256, precision=p)
+        eng_ids[p] = eng.id
+        pred = eng.predict_host(X)                 # compile + warm
+        acc[p] = float(np.mean(np.argmax(pred, -1) == yi))
+        best = float("inf")
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            eng.predict_host(X)
+            best = min(best, time.perf_counter() - t0)
+        qps[p] = n / best
+    d_int8 = acc["int8"] - acc["f32"]
+    d_fp8 = acc["fp8"] - acc["f32"]
+    record_accuracy_delta(eng_ids["int8"], d_int8)
+    record_accuracy_delta(eng_ids["fp8"], d_fp8)
+    # the documented accuracy bars (docs/QUANTIZATION.md) are ASSERTED
+    assert abs(d_int8) <= 0.01, f"int8 accuracy delta {d_int8}: {acc}"
+    assert abs(d_fp8) <= 0.02, f"fp8 accuracy delta {d_fp8}: {acc}"
+
+    # --- (b) decode: int8 weights vs the bf16 (fast: f32) compute path
+    vocab = 77
+    base_dt = None if fast else "bfloat16"
+    net_dec = TextGenerationLSTM(total_unique_characters=vocab,
+                                 compute_dtype=base_dt).init()
+    f32_bytes = tree_bytes(net_dec.params)
+
+    def decode_tps(precision):
+        eng = DecodeEngine(net_dec, slots=streams, max_len=64,
+                           precision=precision)
+        eng.warmup()
+        eng.start()
+        try:
+            eng.generate([1, 2, 3], max_new_tokens=4)     # steady-state
+            best = 0.0
+            for _ in range(passes):
+                rr = np.random.RandomState(23)
+                t0 = time.perf_counter()
+                futs = [eng.submit([int(t) for t in rr.randint(0, vocab, 8)],
+                                   max_new_tokens=gen_tokens, seed=i)
+                        for i in range(streams)]
+                total = sum(len(f.result()["tokens"]) for f in futs)
+                best = max(best, total / (time.perf_counter() - t0))
+            st = eng.stats()
+        finally:
+            eng.stop()
+        return best, st
+
+    base_tps, st_base = decode_tps(None)
+    int8_tps, st_int8 = decode_tps("int8")
+    ratio = st_int8["weight_bytes"] / f32_bytes
+    speedup = int8_tps / base_tps
+    # each (model, precision) pair costs exactly ONE donated program
+    assert st_base["compiled_programs"] == 1, st_base
+    assert st_int8["compiled_programs"] == 1, st_int8
+    assert ratio <= 0.30, f"int8 weight bytes {ratio:.3f}x f32"
+    if not fast:
+        assert speedup >= 1.2, (
+            f"int8 decode {int8_tps:.1f} tok/s is only {speedup:.2f}x the "
+            f"bf16 path's {base_tps:.1f}")
+    return _emit(
+        f"quantized serving (f32/int8/fp8 engines + charRNN int8 decode, "
+        f"{streams} streams)", int8_tps, "tokens/sec", BARS["decode"],
+        {"serving_qps": {p: round(q, 1) for p, q in qps.items()},
+         "eval_accuracy": {p: round(a, 4) for p, a in acc.items()},
+         "accuracy_delta_int8": round(d_int8, 4),
+         "accuracy_delta_fp8": round(d_fp8, 4),
+         "weight_bytes_f32": int(f32_bytes),
+         "weight_bytes_int8": int(st_int8["weight_bytes"]),
+         "int8_bytes_ratio": round(ratio, 3),
+         "decode_baseline_dtype": "f32" if fast else "bf16",
+         "decode_baseline_tokens_per_sec": round(base_tps, 1),
+         "speedup_int8_vs_baseline": round(speedup, 2),
+         "compiled_decode_programs": [st_base["compiled_programs"],
+                                      st_int8["compiled_programs"]],
+         "fast_variant": fast})
+
+
+def bench_ladder(n_req=384, max_batch=64, fast=False):
+    """Measured bucket ladder vs blind pow2 (serving/engine.py autotune).
+    The SAME mixed-size non-pow2 traffic runs through two engines: one on
+    the default pow2 ladder, one whose ladder ``autotune`` derived from
+    the traffic histogram. Reported per engine: compile count, warmup
+    wall, request p50/p99, pad rows. Asserted (the acceptance claims):
+    the autotuned ladder never exceeds pow2's compile count and STRICTLY
+    reduces pad-waste on this traffic mix. The row value is the
+    autotuned pad-waste %; ``vs_baseline`` is its fraction of pow2's
+    (lower is better). ``fast=True`` is the tier-1 CI variant — fewer
+    requests, same assertions (they are counts, not timings)."""
+    import statistics
+    from deeplearning4j_tpu import (MultiLayerNetwork,
+                                    NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import InferenceEngine, bucket_ladder
+
+    if fast:
+        n_req = 96
+    d = 8
+    conf = (NeuralNetConfiguration.builder().seed(5).weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d))
+            .build())
+    rs = np.random.RandomState(19)
+    sizes = rs.choice((1, 2, 3, 5, 6, 7, 11, 13, 21, 27), size=n_req,
+                      p=(.18, .14, .14, .12, .10, .10, .08, .06, .05, .03))
+    reqs = [rs.randn(int(s), d).astype(np.float32) for s in sizes]
+    counts = {int(s): int(c)
+              for s, c in zip(*np.unique(sizes, return_counts=True))}
+
+    def run(eng):
+        eng.warmup((d,), max_batch=max_batch)
+        lats = []
+        for x in reqs:
+            t0 = time.perf_counter()
+            eng.predict_host(x)
+            lats.append(time.perf_counter() - t0)
+        st = eng.stats()
+        return {"warmup_seconds": round(eng.warmup_seconds, 3),
+                "compiled_programs": st["compiled_programs"],
+                "pad_rows": st["pad_rows"],
+                "pad_waste_frac": round(st["pad_waste_frac"], 4),
+                "ladder": st["bucket_ladder"],
+                "p50_ms": round(statistics.median(lats) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2)}
+
+    eng_pow2 = InferenceEngine(MultiLayerNetwork(conf).init(),
+                               max_batch=max_batch)
+    r_pow2 = run(eng_pow2)
+    eng_auto = InferenceEngine(MultiLayerNetwork(conf).init(),
+                               max_batch=max_batch)
+    eng_auto.autotune(counts=counts)      # ladder from the traffic histogram
+    r_auto = run(eng_auto)
+
+    assert r_auto["compiled_programs"] <= r_pow2["compiled_programs"], (
+        r_auto, r_pow2)
+    assert r_auto["pad_rows"] < r_pow2["pad_rows"], (r_auto, r_pow2)
+    return _emit(
+        f"bucket ladder autotuned vs pow2 (mixed non-pow2 sizes, "
+        f"{n_req} requests)", r_auto["pad_waste_frac"] * 100.0, "percent",
+        max(r_pow2["pad_waste_frac"], 1e-9) * 100.0,
+        {"pow2": r_pow2, "autotuned": r_auto,
+         "pow2_ladder": bucket_ladder(max_batch, 1),
+         "pad_rows_saved": r_pow2["pad_rows"] - r_auto["pad_rows"],
+         "fast_variant": fast,
+         "note": "lower is better; vs_baseline is autotuned pad-waste as "
+                 "a fraction of pow2's"})
+
+
 def bench_router(threads=6, requests_per_thread=24):
     """Router row: aggregate QPS + request p50/p99 through the replicated
     serving tier (serving/router.py) — 1 subprocess charlstm replica vs 3,
@@ -1446,7 +1649,9 @@ BENCHES = {
     "lenet": bench_lenet,
     "input_pipeline": bench_input_pipeline,
     "serving": bench_serving,
+    "ladder": bench_ladder,
     "decode": bench_decode,
+    "quantized": bench_quantized,
     "router": bench_router,
     "observability": bench_observability,
     "robustness": bench_robustness,
@@ -1468,7 +1673,7 @@ BENCHES = {
 _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "resnet50": 150, "lenet": 90, "vgg16": 90, "input_pipeline": 120,
         "parallelwrapper": 150, "sharded": 150, "word2vec": 120,
-        "serving": 120,
+        "serving": 120, "ladder": 90, "quantized": 150,
         "decode": 150, "observability": 100, "robustness": 100,
         "router": 150, "online": 120}
 

@@ -16,12 +16,15 @@ from deeplearning4j_tpu.exec.executor import (Executor,  # noqa: F401
                                               PARAMS, STATE, OPT, REPL,
                                               BATCH, STEP_BATCH, SLOTS)
 from deeplearning4j_tpu.exec.routing import (lstm_fwd_route,  # noqa: F401
-                                             set_route, load_measurements)
+                                             decode_attn_route,
+                                             set_route, load_measurements,
+                                             load_measurements_file)
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "build_mesh", "default_mesh",
     "set_default_mesh", "host_device_env",
     "Executor", "get_executor", "set_executor", "param_spec",
     "PARAMS", "STATE", "OPT", "REPL", "BATCH", "STEP_BATCH", "SLOTS",
-    "lstm_fwd_route", "set_route", "load_measurements",
+    "lstm_fwd_route", "decode_attn_route", "set_route",
+    "load_measurements", "load_measurements_file",
 ]

@@ -92,7 +92,8 @@ class Executor:
 
     def __init__(self, mesh: Optional[Mesh] = None, *,
                  min_rows_per_shard: Optional[int] = None,
-                 min_slots_per_shard: Optional[int] = None):
+                 min_slots_per_shard: Optional[int] = None,
+                 precision: Optional[str] = None):
         self.mesh = default_mesh() if mesh is None else mesh
         self.data_size = (self.mesh.shape[DATA_AXIS]
                           if DATA_AXIS in self.mesh.axis_names else 1)
@@ -104,6 +105,22 @@ class Executor:
                   else int(min_rows_per_shard))
         self.min_slots = 2 if min_slots_per_shard is None \
             else int(min_slots_per_shard)
+        # declarative serving precision: every engine built against this
+        # executor (bucketed forward, decode step, replica --checkpoint
+        # loads) inherits it without per-caller code (docs/QUANTIZATION.md)
+        from deeplearning4j_tpu.quant import resolve_precision
+        self.precision = resolve_precision(
+            precision if precision is not None
+            else os.environ.get("DL4JTPU_PRECISION"))
+
+    def prepare_params(self, tree, precision: Optional[str] = None):
+        """Apply the serving-precision policy to a weight tree: per-channel
+        weight-only quantization for 'int8'/'fp8', the identity (same
+        objects, bitwise f32 path) for 'f32'. Engines call this once at
+        load/swap time — never per request."""
+        from deeplearning4j_tpu.quant import quantize_tree
+        p = precision if precision is not None else self.precision
+        return quantize_tree(tree, p)
 
     # ------------------------------------------------------------- shardings
     @property

@@ -1,36 +1,52 @@
-"""Shape-keyed kernel-vs-scan routing (data-driven, overridable).
+"""Shape-keyed kernel-vs-reference routing (data-driven, overridable).
 
-The fused-LSTM Pallas kernel does not win everywhere: KERNELS_TPU.json
-(bench_kernels, v5e) shows the forward LOSING to XLA's scan codegen at
-small ``B*H`` (latency-bound — (4,16,8) runs at 0.1x) and on two shapes
-the old ``B*H >= 2048`` heuristic routed to Pallas anyway:
+A hand-written kernel does not win everywhere: KERNELS_TPU.json
+(bench_kernels, v5e) shows the fused-LSTM forward LOSING to XLA's scan
+codegen at small ``B*H`` for BOTH dtypes (bf16 (4,16,8) runs at 0.1x,
+(1,4,8) at 0.03x) and on two f32 shapes the old ``B*H >= 2048``
+heuristic routed to Pallas anyway:
 
     (16, 64, 128, float32)  fwd 0.96x   — crossover shape, scan wins
     (32, 128, 256, float32) fwd 0.72x   — long-T f32: double-width
                                           streams, scan pipelines better
 
 This module owns the routing decision per (backend, kernel, phase,
-shape): exact measured shapes first (the table below is distilled from
-KERNELS_TPU.json and can be re-derived with ``load_measurements``),
-then the measured heuristic for everything in between. The backward
-kernel wins at every validated shape, so only the forward routes.
+shape). The shipped measurement file (KERNELS_TPU.json at the repo
+root) is absorbed wholesale at first use — every row with a measured
+``fwd_speedup`` routes to pallas iff it beat XLA, for f32 and bf16
+alike — and the measured heuristic covers everything in between. The
+backward kernel wins at every validated shape, so only the forward
+routes.
 
 Overrides, strongest first:
 
-1. ``set_route("fused_lstm", "pallas"|"scan"|None)`` — programmatic pin
-2. ``DL4JTPU_LSTM_FWD_ROUTE=pallas|scan`` — environment pin
-3. measured per-shape table (exact (B, T, H, dtype) match)
+1. ``set_route(kernel, "pallas"|"scan"|None)`` — programmatic pin
+   (per kernel: "fused_lstm", "decode_attn")
+2. ``DL4JTPU_LSTM_FWD_ROUTE`` / ``DL4JTPU_DECODE_ATTN_ROUTE`` —
+   environment pins
+3. measured per-shape table (exact (B, T, H, dtype) match, seeded from
+   the shipped KERNELS_TPU.json via ``load_measurements``)
 4. heuristic: scan when ``B*H < 2048``; f32 additionally needs
-   ``B*H > 2048`` and ``T < 128`` (both measured losses above sit on
-   those boundaries); otherwise pallas
+   ``B*H > 2048`` and ``T < 128`` (both measured f32 losses above sit
+   on those boundaries); otherwise pallas
+
+The flash decode-step kernel (ops/flash_decode.py) routes through the
+same table: ``decode_attn_route`` defaults to pallas wherever the
+kernel supports the shape (the decode step is bandwidth-bound on the
+KV cache at every capacity, and the kernel reads only ``pos+1`` of the
+``C`` cached rows), with the same pin/env overrides for tests and
+rollbacks.
 """
 
+import json
 import os
-from typing import Optional
+from typing import Dict, Optional
 
-# exact measured rows where the decision differs per shape — distilled
-# from KERNELS_TPU.json (only rows the heuristic alone would misroute
-# need listing; kept small and human-auditable on purpose)
+# exact measured rows where the decision differs per shape. Seeded from
+# the shipped KERNELS_TPU.json on first lookup (``load_measurements``
+# absorbs every measured row — bf16 exactly like f32); the literal
+# entries below keep the module meaningful without the file and remain
+# human-auditable.
 _MEASURED = {
     # (kernel, B, T, H, dtype) -> route        measured fwd speedup
     ("fused_lstm", 16, 64, 128, "float32"): "scan",     # 0.96x
@@ -44,16 +60,20 @@ _MEASURED = {
 # measured latency/bandwidth crossover (see ops/lstm_pallas.py docstring)
 _MIN_BH = 2048
 
-_forced: Optional[str] = None
+_forced: Dict[str, str] = {}      # kernel -> pinned route
+_file_loaded = False
 
 
 def set_route(kernel: str, route: Optional[str]) -> None:
-    """Pin every ``kernel`` forward to ``route`` ('pallas'/'scan'), or
-    None to restore data-driven routing. Test/debug hook."""
-    global _forced
+    """Pin every ``kernel`` forward to ``route`` ('pallas'/'scan' — for
+    ``decode_attn``, 'scan' means the dense reference step), or None to
+    restore data-driven routing. Test/debug hook."""
     if route not in (None, "pallas", "scan"):
         raise ValueError(f"route must be pallas/scan/None, got {route!r}")
-    _forced = route
+    if route is None:
+        _forced.pop(kernel, None)
+    else:
+        _forced[kernel] = route
 
 
 def load_measurements(results, kernel: str = "fused_lstm") -> int:
@@ -64,10 +84,37 @@ def load_measurements(results, kernel: str = "fused_lstm") -> int:
     for row in results:
         if row.get("kernel") != kernel or row.get("fwd_speedup") is None:
             continue
-        key = (kernel, row["B"], row["T"], row["H"], row["dtype"])
+        key = (kernel, row.get("B"), row.get("T"), row.get("H"),
+               row.get("dtype"))
         _MEASURED[key] = "pallas" if row["fwd_speedup"] > 1 else "scan"
         n += 1
     return n
+
+
+def load_measurements_file(path: Optional[str] = None) -> int:
+    """Absorb a KERNELS_TPU.json bench file (default: the one shipped at
+    the repo root) for every kernel it measures. Idempotent; rows merge
+    into the same table ``load_measurements`` feeds."""
+    if path is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(os.path.dirname(os.path.dirname(here)),
+                            "KERNELS_TPU.json")
+    if not os.path.exists(path):
+        return 0
+    with open(path) as f:
+        results = json.load(f).get("results", [])
+    kernels = {r.get("kernel") for r in results} - {None}
+    return sum(load_measurements(results, kernel=k) for k in sorted(kernels))
+
+
+def _ensure_file_measurements() -> None:
+    """Lazy one-shot load of the shipped measurement file, so the per-shape
+    choice is measurement-driven for every dtype it covers (the bf16
+    small-shape losses included) without any caller wiring."""
+    global _file_loaded
+    if not _file_loaded:
+        _file_loaded = True
+        load_measurements_file()
 
 
 def lstm_fwd_route(b: int, h: int, t: Optional[int] = None,
@@ -77,14 +124,16 @@ def lstm_fwd_route(b: int, h: int, t: Optional[int] = None,
 
     ``backend`` other than TPU always scans (the kernel only compiles
     for Mosaic; CPU/interpret callers gate on that before asking)."""
-    if _forced is not None:
-        return _forced
+    forced = _forced.get("fused_lstm")
+    if forced is not None:
+        return forced
     env = os.environ.get("DL4JTPU_LSTM_FWD_ROUTE", "").strip().lower()
     if env in ("pallas", "scan"):
         return env
     if backend is not None and backend != "tpu":
         return "scan"
     if t is not None and dtype is not None:
+        _ensure_file_measurements()
         hit = _MEASURED.get(("fused_lstm", b, t, h, str(dtype)))
         if hit is not None:
             return hit
@@ -92,5 +141,26 @@ def lstm_fwd_route(b: int, h: int, t: Optional[int] = None,
         return "scan"
     if str(dtype) == "float32" and (b * h <= _MIN_BH
                                     or (t is not None and t >= 128)):
+        return "scan"
+    return "pallas"
+
+
+def decode_attn_route(c: Optional[int] = None, dh: Optional[int] = None,
+                      backend: Optional[str] = None) -> str:
+    """Route the attention decode step: 'pallas' (flash decode-step
+    kernel, ops/flash_decode.py) or 'scan' (the dense reference step —
+    the path the bitwise-parity decode tests pin on CPU).
+
+    Default is pallas wherever the kernel supports the shape: the step
+    is HBM-bound on the KV cache and the kernel stops reading at the
+    cache position, so it wins by construction once the cache is larger
+    than one block (the caller screens ``supported(c, dh)`` first)."""
+    forced = _forced.get("decode_attn")
+    if forced is not None:
+        return forced
+    env = os.environ.get("DL4JTPU_DECODE_ATTN_ROUTE", "").strip().lower()
+    if env in ("pallas", "scan"):
+        return env
+    if backend is not None and backend != "tpu":
         return "scan"
     return "pallas"

@@ -144,6 +144,25 @@ class MultiHeadAttention(Layer):
         kc = dstate["k"].at[rows, pos].set(k[:, 0])
         vc = dstate["v"].at[rows, pos].set(v[:, 0])
         C = kc.shape[1]
+        from deeplearning4j_tpu import ops
+        if ops.helpers_enabled():
+            from deeplearning4j_tpu.exec import decode_attn_route
+            from deeplearning4j_tpu.ops import flash_decode
+            Dh = q.shape[-1]
+            # interpret mode exercises the kernel on any backend (tests);
+            # compiled mode asks routing with the real platform
+            backend = None if ops.interpret_mode() else jax.default_backend()
+            if (flash_decode.supported(C, Dh)
+                    and decode_attn_route(C, Dh, backend=backend)
+                    == "pallas"):
+                # flash decode-step: reads only pos+1 of the C cached rows
+                dt = q.dtype
+                o = ops.flash_decode_step(q[:, 0], kc, vc, pos,
+                                          interpret=ops.interpret_mode())
+                o = o.reshape(B, 1, self.n_out).astype(dt) @ params["Wo"]
+                if self.has_bias:
+                    o = o + params["bo"]
+                return o, {"k": kc, "v": vc}
         scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale     # (B, H, 1, C)
         valid = jnp.arange(C)[None, :] <= pos[:, None]       # (B, C)

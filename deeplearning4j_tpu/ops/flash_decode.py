@@ -1,0 +1,123 @@
+"""Flash decode-step (Pallas TPU): q-length-1 online-softmax attention
+over a cached KV, masked by cache position.
+
+The dense decode step (nn/layers/attention.py ``decode_step``) computes
+scores against the FULL cache capacity ``C`` every token and masks the
+future with ``-inf`` — O(C) HBM reads and O(C) flops per token no
+matter how short the live prefix is. This kernel applies the
+FlashAttention decomposition (Dao et al. 2022) to the single-query
+case: the softmax is computed online per key block, and the block loop
+STOPS at the block containing ``pos`` — work and bytes scale with the
+live prefix length, not the allocated capacity. For a capacity-1024
+cache at position 63 that is a 16x read reduction; it is the decode-side
+companion of the training-side flash kernel (ops/flash_attention.py).
+
+Layout: one grid program per (batch row x head). The query row is
+replicated to 8 sublanes OUTSIDE the kernel so every block meets the
+f32 (8, 128) tile floor — the 7 duplicate rows are VPU noise next to
+the KV stream, and row 0 is written back. f32 accumulation throughout.
+
+Supported: cache capacity divisible by a block size (8..128), head dim
+a multiple of 8, K+V within a conservative VMEM budget. Callers screen
+with ``supported()`` and fall back to the dense step (which the
+bitwise-parity tests pin on CPU), mirroring the cuDNN-helper seam.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+_QROWS = 8                     # sublane floor for f32 tiles
+
+
+def _pick_block(c):
+    for b in (128, 64, 32, 16, 8):
+        if c % b == 0:
+            return b
+    return None
+
+
+def supported(c, dh):
+    """Shape screen: blockable capacity, lane-aligned head dim, K+V for
+    one (batch, head) row within a conservative VMEM budget."""
+    return (_pick_block(c) is not None and dh % 8 == 0
+            and 2 * c * dh * 4 <= 8 * 1024 * 1024)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, blk, c_total,
+                   scale):
+    p = pos_ref[0, 0]                           # this row's cache position
+    q = q_ref[0]                                # (_QROWS, Dh) replicated query
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * blk, blk), :]   # (blk, Dh)
+        vb = v_ref[0, pl.ds(j * blk, blk), :]
+        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        kpos = j * blk + lax.broadcasted_iota(jnp.int32, (_QROWS, blk), 1)
+        s = jnp.where(kpos <= p, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + pexp.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(pexp, vb,
+                                    preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((_QROWS, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((_QROWS, 1), jnp.float32)
+    a0 = jnp.zeros((_QROWS, q.shape[-1]), jnp.float32)
+    # the flash decode win: stop at the block holding ``pos`` — everything
+    # beyond it is masked anyway, so it is never read from HBM
+    upper = p // blk + 1
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, a0))
+    o_ref[0] = acc / l
+
+
+def flash_decode_step(q, kc, vc, pos, *, interpret=False):
+    """One attention decode step for every (batch, head) row.
+
+    ``q``: (B, H, Dh) query at the current position; ``kc``/``vc``:
+    (B, C, H, Dh) KV cache with position ``pos`` already written;
+    ``pos``: (B,) int32 cache positions. Returns (B, H, Dh) f32 —
+    softmax(q·K[:pos+1])·V[:pos+1] per head."""
+    B, H, Dh = q.shape
+    C = kc.shape[1]
+    blk = _pick_block(C)
+    if blk is None:
+        raise ValueError(f"cache capacity {C} not blockable")
+    scale = 1.0 / (Dh ** 0.5)
+
+    fold = lambda a: (a.transpose(0, 2, 1, 3)
+                      .reshape(B * H, C, Dh).astype(jnp.float32))
+    kf, vf = fold(kc), fold(vc)
+    qf = jnp.broadcast_to(q.astype(jnp.float32)[:, :, None, :],
+                          (B, H, _QROWS, Dh)).reshape(B * H, _QROWS, Dh)
+    posf = jnp.repeat(jnp.asarray(pos, jnp.int32), H).reshape(B * H, 1)
+
+    kern = functools.partial(_decode_kernel, blk=blk, c_total=C, scale=scale)
+    o = pl.pallas_call(
+        kern,
+        grid=(B * H,),
+        in_specs=[pl.BlockSpec((1, 1), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, _QROWS, Dh), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, C, Dh), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, C, Dh), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, _QROWS, Dh), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, _QROWS, Dh), jnp.float32),
+        interpret=interpret,
+    )(posf, qf, kf, vf)
+    return o[:, 0, :].reshape(B, H, Dh)

@@ -25,6 +25,22 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def _is_initialized() -> bool:
+    """``jax.distributed.is_initialized`` without requiring it: older jax
+    releases (0.4.3x) don't expose the predicate, but the global
+    distributed state object it reads exists on every release — checking
+    its client slot is the same test and still never touches the XLA
+    backend."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:       # noqa: BLE001 — private layout moved: assume no
+        return False
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None):
@@ -33,7 +49,7 @@ def initialize(coordinator_address: Optional[str] = None,
     Call once per host process before building meshes — and before ANYTHING
     that touches the XLA backend (jax.devices/process_count included), which
     is why the already-initialized check must not query the backend."""
-    if jax.distributed.is_initialized():
+    if _is_initialized():
         return
     kwargs = {}
     if coordinator_address or os.environ.get("COORDINATOR_ADDRESS"):

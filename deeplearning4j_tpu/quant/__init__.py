@@ -1,0 +1,20 @@
+"""Low-precision serving: weight-only int8/fp8 quantization.
+
+One module owns the mechanism (``qtensor.py``); the policy surface is
+``exec.Executor(precision=...)`` / ``DL4JTPU_PRECISION`` — the engines
+(serving/engine.py, serving/decode.py) quantize at load/swap time and
+dequantize on the fly inside their compiled programs. See
+docs/QUANTIZATION.md.
+"""
+
+from deeplearning4j_tpu.quant.qtensor import (  # noqa: F401
+    PRECISIONS, QTensor, dequantize, dequantize_tree, quant_error_report,
+    quantize, quantize_tree, record_accuracy_delta, record_weight_bytes,
+    resolve_precision, tree_bytes)
+
+__all__ = [
+    "PRECISIONS", "QTensor", "quantize", "dequantize",
+    "quantize_tree", "dequantize_tree", "tree_bytes",
+    "quant_error_report", "resolve_precision",
+    "record_weight_bytes", "record_accuracy_delta",
+]

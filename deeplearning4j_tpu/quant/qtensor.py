@@ -1,0 +1,231 @@
+"""Weight-only low-precision serving: per-channel symmetric quantization.
+
+The serve-side hot paths (bucketed forward, continuous-batching decode
+step) are memory-bound: every device call streams the full weight tree
+from HBM. Weight-only quantization (the LLM.int8() observation, Dettmers
+et al. 2022) cuts that traffic ~4x by storing weights as int8 (or
+fp8-e4m3) codes plus one f32 scale per OUTPUT channel, and dequantizing
+on the fly INSIDE the compiled program — XLA fuses the
+``codes.astype(f32) * scale`` expansion into the consuming matmul, so
+the f32 activation math is unchanged and only the weight bytes shrink.
+
+Two precisions, one mechanism:
+
+- ``int8``: codes in [-127, 127], ``scale = amax / 127`` per channel.
+  ~0.25x weight bytes; typical per-layer max-abs-err ~amax/254.
+- ``fp8``: ``jnp.float8_e4m3fn`` codes (max finite 448), ``scale =
+  amax / 448``. Same bytes as int8 but a floating mantissa: relative
+  error is roughly uniform across magnitudes instead of absolute.
+- ``f32``: the identity policy. ``quantize_tree`` returns the tree
+  UNTOUCHED (same array objects), so the f32 serving path stays
+  bitwise-identical and compiles the exact same programs.
+
+Per-channel means per OUTPUT channel — the LAST axis of a kernel
+(``(n_in, n_out)`` dense, ``(kh, kw, cin, cout)`` conv, the gate-stacked
+``(n_in, 4*n_out)`` LSTM input kernel). A per-last-axis scale commutes
+with the matmul's contraction (every contracted element of a column
+shares one scale), which is what keeps dequant-on-the-fly exact up to
+the rounding already paid at quantize time.
+
+Policy (what quantizes): float leaves with ``ndim >= 2`` whose path
+matches no entry of the exclusion list. Biases, norm gains/shifts and
+other 1-D leaves stay f32 — they are a rounding error of the byte
+budget and quantizing them buys nothing. The default exclusion list is
+empty; pass ``exclude=("P",)`` etc. to keep e.g. positional embeddings
+full-precision (docs/QUANTIZATION.md).
+
+``QTensor`` is a registered pytree, so quantized trees flow through
+``Executor.jit`` unchanged: the codes and scales become ordinary device
+arrays of the program, jit signatures key on their dtypes, and swapping
+a same-shape quantized tree hits the compiled-program cache exactly
+like an f32 swap (the zero-new-compiles invariant the serving tests
+pin).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+PRECISIONS = ("f32", "int8", "fp8")
+
+# fp8-e4m3 (fn variant): max finite magnitude
+_FP8_MAX = 448.0
+
+
+def resolve_precision(precision: Optional[str]) -> str:
+    """Normalize/validate a precision name (None → 'f32')."""
+    p = (precision or "f32").strip().lower()
+    aliases = {"float32": "f32", "fp32": "f32", "none": "f32",
+               "i8": "int8", "e4m3": "fp8", "fp8_e4m3": "fp8",
+               "float8": "fp8"}
+    p = aliases.get(p, p)
+    if p not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {precision!r} (want one of {PRECISIONS})")
+    return p
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class QTensor:
+    """One quantized weight: ``codes`` (int8 / fp8 array, original shape)
+    and ``scale`` (f32, shape broadcastable as one scale per last-axis
+    channel). ``dequantize(qt)`` reconstructs f32."""
+
+    codes: jnp.ndarray
+    scale: jnp.ndarray
+
+    # pytree protocol: codes+scale are children, so quantized trees pass
+    # through jit/device_put/tree_map like any other weight tree
+    def tree_flatten(self):
+        return (self.codes, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.codes.shape
+
+    @property
+    def dtype(self):
+        return self.codes.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return (self.codes.size * self.codes.dtype.itemsize
+                + self.scale.size * self.scale.dtype.itemsize)
+
+
+def _is_q(x) -> bool:
+    return isinstance(x, QTensor)
+
+
+def _channel_amax(w):
+    """max|w| per last-axis channel, keepdims — one scale per output
+    channel, broadcastable against ``w``."""
+    axes = tuple(range(w.ndim - 1))
+    return jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+
+
+def quantize(w, precision: str) -> QTensor:
+    """Per-channel symmetric quantization of one ``ndim>=2`` float array."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = _channel_amax(w)
+    if precision == "int8":
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        codes = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    elif precision == "fp8":
+        scale = jnp.where(amax > 0, amax / _FP8_MAX, 1.0)
+        codes = (w / scale).astype(jnp.float8_e4m3fn)
+    else:
+        raise ValueError(f"quantize() wants int8/fp8, got {precision!r}")
+    return QTensor(codes, scale.astype(jnp.float32))
+
+
+def dequantize(qt: QTensor):
+    """f32 reconstruction. Inside a jitted forward this is the
+    dequant-on-the-fly expansion XLA fuses into the consuming matmul."""
+    return qt.codes.astype(jnp.float32) * qt.scale
+
+
+def _eligible(path: str, leaf, exclude: Sequence[str]) -> bool:
+    if _is_q(leaf) or getattr(leaf, "ndim", 0) < 2:
+        return False
+    if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+        return False
+    return not any(tok in path for tok in exclude)
+
+
+def quantize_tree(tree, precision: str, exclude: Sequence[str] = ()):
+    """Quantize every eligible leaf of a weight pytree; 'f32' returns the
+    tree unchanged (same objects — the bitwise-identity policy)."""
+    precision = resolve_precision(precision)
+    if precision == "f32":
+        return tree
+
+    def q(path, leaf):
+        key = jax.tree_util.keystr(path)
+        return quantize(leaf, precision) if _eligible(key, leaf, exclude) \
+            else leaf
+    return jax.tree_util.tree_map_with_path(q, tree)
+
+
+def dequantize_tree(tree):
+    """Reconstruct f32 leaves from any QTensor nodes; plain leaves pass
+    through untouched, so on an f32 tree this is the identity (zero ops
+    traced — the f32 path compiles the exact same program)."""
+    return jax.tree_util.tree_map(
+        lambda x: dequantize(x) if _is_q(x) else x, tree,
+        is_leaf=_is_q)
+
+
+def tree_bytes(tree) -> int:
+    """Total weight bytes of a (possibly quantized) tree — codes + scales
+    for QTensor leaves, raw array bytes otherwise."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree, is_leaf=_is_q):
+        if _is_q(leaf):
+            total += leaf.nbytes
+        else:
+            a = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+            total += int(np.prod(a.shape, dtype=np.int64)
+                         * jnp.dtype(a.dtype).itemsize) if a.ndim else \
+                jnp.dtype(a.dtype).itemsize
+    return int(total)
+
+
+def quant_error_report(tree, qtree) -> dict:
+    """Per-leaf max-abs-err of a quantized tree vs its f32 source — the
+    quality check docs/QUANTIZATION.md's accuracy bars are stated over.
+    Returns {path: err} for quantized leaves plus ``"max"`` (worst leaf)
+    and ``"rel_max"`` (worst err / amax)."""
+    report, worst, worst_rel = {}, 0.0, 0.0
+    flat = {jax.tree_util.keystr(p): l for p, l
+            in jax.tree_util.tree_flatten_with_path(tree)[0]}
+    qflat = {jax.tree_util.keystr(p): l for p, l
+             in jax.tree_util.tree_flatten_with_path(
+                 qtree, is_leaf=_is_q)[0]}
+    for key, ql in qflat.items():
+        if not _is_q(ql):
+            continue
+        w = np.asarray(flat[key], np.float32)
+        err = float(np.max(np.abs(w - np.asarray(dequantize(ql)))))
+        amax = float(np.max(np.abs(w)))
+        report[key] = err
+        worst = max(worst, err)
+        if amax > 0:
+            worst_rel = max(worst_rel, err / amax)
+    report["max"] = worst
+    report["rel_max"] = worst_rel
+    return report
+
+
+# ------------------------------------------------------------------ metrics
+def record_weight_bytes(engine: str, precision: str, nbytes: int) -> None:
+    """Publish ``dl4jtpu_weight_bytes{engine, precision}`` (the serving
+    tier's resident weight footprint; OBSERVABILITY.md catalog)."""
+    from deeplearning4j_tpu.monitor import get_registry
+    get_registry().gauge(
+        "dl4jtpu_weight_bytes",
+        "Device-resident serving weight bytes per engine and precision "
+        "(codes + scales for quantized trees).",
+        ("engine", "precision")).labels(
+            engine=engine, precision=precision).set(float(nbytes))
+
+
+def record_accuracy_delta(engine: str, delta: float) -> None:
+    """Publish ``dl4jtpu_quant_accuracy_delta{engine}`` — (quantized −
+    f32) end-to-end eval accuracy, set by the quality checks / bench."""
+    from deeplearning4j_tpu.monitor import get_registry
+    get_registry().gauge(
+        "dl4jtpu_quant_accuracy_delta",
+        "End-to-end eval accuracy delta of the quantized serving path vs "
+        "f32 (0 when serving f32).", ("engine",)).labels(
+            engine=engine).set(float(delta))

@@ -48,7 +48,10 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.monitor import get_registry, trace
 from deeplearning4j_tpu.resilience.errors import (
     BatcherStoppedError, ServerOverloadedError)
-from deeplearning4j_tpu.serving.engine import validate_swap
+from deeplearning4j_tpu.quant import (dequantize_tree, record_weight_bytes,
+                                      resolve_precision, tree_bytes)
+from deeplearning4j_tpu.serving.engine import (_tree_signature,
+                                               _validate_sig, validate_swap)
 
 
 class _Request:
@@ -92,7 +95,8 @@ class DecodeEngine:
     _ids = itertools.count()
 
     def __init__(self, model, slots: int = 8, max_len: int = 256,
-                 eos_id: Optional[int] = None, max_queue: int = 256):
+                 eos_id: Optional[int] = None, max_queue: int = 256,
+                 precision: Optional[str] = None):
         self.model = model
         self.slots = int(slots)
         self.max_len = int(max_len)
@@ -106,6 +110,13 @@ class DecodeEngine:
 
         from deeplearning4j_tpu import exec as ex
         execu = getattr(model, "_executor", None) or ex.get_executor()
+        # serving precision (engine.py policy, docs/QUANTIZATION.md):
+        # int8/fp8 pins the quantized weights now and keeps the f32
+        # signature so staged swaps validate f32 candidates and quantize
+        # AFTER the gate — the one step program never re-traces
+        self.precision = (resolve_precision(precision)
+                          if precision is not None else execu.precision)
+        self._raw_sig = None
         self._step = execu.jit(
             self._step_impl,
             in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS, ex.BATCH, ex.BATCH,
@@ -114,6 +125,11 @@ class DecodeEngine:
             donate_argnums=(2,))
         self._dstate = None
         self._live = None          # (params, state) after the first swap
+        if self.precision != "f32":
+            self._raw_sig = _tree_signature(model.params)
+            qp = execu.prepare_params(model.params, self.precision)
+            st = jax.tree_util.tree_map(jnp.asarray, model.state)
+            self._live = (qp, st)
         self._pending_swap = None  # staged (params, state, version, Event)
         self._version = 0
         self._slot_reqs: List[Optional[_Request]] = [None] * self.slots
@@ -159,6 +175,9 @@ class DecodeEngine:
             "Weight hot-swaps applied with zero new XLA compiles.",
             ("engine",)).labels(**lab)
         self._m_version.set(0.0)
+        if self.precision != "f32":
+            record_weight_bytes(self.id, self.precision,
+                                tree_bytes(self._live[0]))
 
     @property
     def trace_count(self) -> int:
@@ -191,10 +210,20 @@ class DecodeEngine:
         untouched), and identical shapes/dtypes mean the single compiled
         step program is reused — zero new XLA compiles."""
         cur_p, cur_s = self._weights()
-        validate_swap(cur_p, params, "decode params")
+        if self._raw_sig is not None:
+            _validate_sig(self._raw_sig, _tree_signature(params),
+                          "decode params")
+        else:
+            validate_swap(cur_p, params, "decode params")
         if state is not None:
             validate_swap(cur_s, state, "decode state")
         params = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.precision != "f32":
+            from deeplearning4j_tpu import exec as ex
+            execu = getattr(self.model, "_executor", None) \
+                or ex.get_executor()
+            params = execu.prepare_params(params, self.precision)
+            record_weight_bytes(self.id, self.precision, tree_bytes(params))
         state = (cur_s if state is None
                  else jax.tree_util.tree_map(jnp.asarray, state))
         applied = threading.Event()
@@ -237,6 +266,10 @@ class DecodeEngine:
         every call shares a single XLA program; scheduling decisions ride in
         as data (masks), never as shapes."""
         self._m_compiled.inc()   # traced-only: exact compiled-program count
+        # dequant-on-the-fly (identity on the f32 path): int8/fp8 weights
+        # stream from HBM at quantized width every step — the decode step
+        # is weight-bandwidth-bound, so this is where low precision pays
+        params = dequantize_tree(params)
         S = self.slots
 
         def wipe(a):
@@ -448,6 +481,8 @@ class DecodeEngine:
         return {"id": self.id,
                 "slots": self.slots,
                 "max_len": self.max_len,
+                "precision": self.precision,
+                "weight_bytes": tree_bytes(self._weights()[0]),
                 "model_version": self._version,
                 "occupied_slots": occupied,
                 "queued_requests": queued,

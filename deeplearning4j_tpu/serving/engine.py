@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.monitor import get_registry, trace
+from deeplearning4j_tpu.quant import (dequantize_tree, record_weight_bytes,
+                                      resolve_precision, tree_bytes)
 from deeplearning4j_tpu.resilience.errors import WeightSwapError
 
 
@@ -58,7 +60,15 @@ def validate_swap(current, candidate, what: str = "params") -> None:
     any engine state is touched — is what makes a rejected swap a no-op; a
     mismatch that slipped through would either retrace a fresh XLA program
     (shape/dtype change) or crash a device call mid-request."""
-    cur, new = _tree_signature(current), _tree_signature(candidate)
+    _validate_sig(_tree_signature(current), _tree_signature(candidate), what)
+
+
+def _validate_sig(cur, new, what: str = "params") -> None:
+    """Signature-level half of ``validate_swap``: quantizing engines keep
+    the ORIGINAL f32 signature and validate swap candidates against it
+    (candidates always arrive in f32 — quantization happens after the
+    gate, so the quantized shapes/dtypes match the live program's and the
+    jit cache still hits)."""
     problems = []
     for key in sorted(set(cur) - set(new)):
         problems.append(f"missing array {key!r}")
@@ -74,10 +84,18 @@ def validate_swap(current, candidate, what: str = "params") -> None:
             f"candidate {what} incompatible with live weights", problems)
 
 
-def bucket_for(n: int, max_batch: int, min_bucket: int = 1) -> int:
-    """Smallest power-of-two ≥ n (clamped to [min_bucket, max_batch])."""
+def bucket_for(n: int, max_batch: int, min_bucket: int = 1,
+               ladder: Optional[Sequence[int]] = None) -> int:
+    """Smallest rung ≥ n. Default rungs are the power-of-two ladder; an
+    explicit ``ladder`` (sorted ascending, topped by max_batch — the
+    autotuned ladders ``autotune_ladder`` produces) overrides it."""
     if n < 1:
         raise ValueError(f"batch size must be ≥ 1, got {n}")
+    if ladder:
+        for b in ladder:
+            if b >= n:
+                return b
+        return ladder[-1]
     b = max(min_bucket, 1)
     while b < n:
         b <<= 1
@@ -93,6 +111,96 @@ def bucket_ladder(max_batch: int, min_bucket: int = 1) -> List[int]:
         b <<= 1
     out.append(max_batch)
     return out
+
+
+def autotune_ladder(counts, max_batch: int, max_rungs: Optional[int] = None,
+                    min_bucket: int = 1) -> List[int]:
+    """Choose bucket rungs from MEASURED traffic instead of blind powers
+    of two.
+
+    ``counts`` maps observed batch size -> request count (the engine's
+    per-size histogram). Candidate rungs are the observed sizes plus the
+    pow2 rungs; a DP picks at most ``max_rungs`` of them (default: the
+    pow2 ladder's length) minimizing total padding rows, with
+    ``max_batch`` always kept as the top rung so oversize chunking still
+    works. The pow2 ladder itself is a feasible choice, so the optimum
+    NEVER pads more than pow2 does, with never more rungs (= compiled
+    programs) — the two acceptance bars the bench row asserts.
+    """
+    pow2 = bucket_ladder(max_batch, min_bucket)
+    K = int(max_rungs) if max_rungs else len(pow2)
+    lo = max(min_bucket, 1)
+    # sizes above max_batch arrive pre-chunked (the dispatch recursion
+    # re-buckets tails), below min_bucket they pad up to it
+    sizes = {}
+    for s, c in dict(counts).items():
+        s = min(max(int(s), lo), max_batch)
+        sizes[s] = sizes.get(s, 0) + int(c)
+    if not sizes:
+        return pow2
+    cand = sorted(set(sizes) | set(pow2) | {max_batch})
+    cand = [c for c in cand if lo <= c <= max_batch]
+
+    def seg_cost(i: int, j: int) -> float:
+        """Pad rows when sizes in (cand[i], cand[j]] all round to cand[j]."""
+        lo_v = cand[i] if i >= 0 else 0
+        r = cand[j]
+        return float(sum(c * (r - s) for s, c in sizes.items()
+                         if lo_v < s <= r))
+
+    p = len(cand)
+    INF = float("inf")
+    dp = [[INF] * (K + 1) for _ in range(p)]
+    back = [[None] * (K + 1) for _ in range(p)]
+    for j in range(p):
+        dp[j][1] = seg_cost(-1, j)
+        for k in range(2, K + 1):
+            for i in range(j):
+                if dp[i][k - 1] == INF:
+                    continue
+                v = dp[i][k - 1] + seg_cost(i, j)
+                if v < dp[j][k]:
+                    dp[j][k] = v
+                    back[j][k] = i
+    top = p - 1                              # cand[top] == max_batch
+    best_k = min(range(1, K + 1), key=lambda k: (dp[top][k], k))
+    rungs, j, k = [cand[top]], top, best_k
+    while k > 1 and back[j][k] is not None:
+        j = back[j][k]
+        k -= 1
+        rungs.append(cand[j])
+    return sorted(rungs)
+
+
+def prune_ladder(ladder: Sequence[int], counts, rung_costs) -> List[int]:
+    """Drop rungs whose measured one-time compile cost exceeds the padding
+    run-time they save on the observed traffic.
+
+    ``rung_costs`` maps rung -> {"compile_s", "run_s"} as recorded by
+    ``warmup()``. A rung saves (next_rung - rung) pad rows per request it
+    absorbs; valued at the rung's measured per-row run time, if that
+    saving is worth less wall-clock than the rung's compile, the rung is
+    merged upward. The top rung is never dropped. This trades pad-waste
+    back for compiles, so it is opt-in (``autotune(prune=True)``)."""
+    ladder = sorted(ladder)
+    sizes = {int(s): int(c) for s, c in dict(counts).items()}
+    changed = True
+    while changed and len(ladder) > 1:
+        changed = False
+        for idx in range(len(ladder) - 1):
+            r, nxt = ladder[idx], ladder[idx + 1]
+            cost = rung_costs.get(r, {})
+            compile_s, run_s = cost.get("compile_s"), cost.get("run_s")
+            if compile_s is None or run_s is None or run_s <= 0:
+                continue
+            lo = ladder[idx - 1] if idx > 0 else 0
+            absorbed = sum(c for s, c in sizes.items() if lo < s <= r)
+            extra_run_s = absorbed * (nxt - r) * (run_s / max(r, 1))
+            if extra_run_s < compile_s:
+                ladder.pop(idx)
+                changed = True
+                break
+    return ladder
 
 
 class InferenceEngine:
@@ -115,7 +223,9 @@ class InferenceEngine:
 
     _ids = itertools.count()
 
-    def __init__(self, model, max_batch: int = 1024, min_bucket: int = 1):
+    def __init__(self, model, max_batch: int = 1024, min_bucket: int = 1,
+                 precision: Optional[str] = None):
+        from deeplearning4j_tpu import exec as ex
         self.model = model
         self.max_batch = int(max_batch)
         self.min_bucket = int(min_bucket)
@@ -126,6 +236,30 @@ class InferenceEngine:
         self._version = 0
         self._is_graph = hasattr(model.conf, "network_inputs")
         self.warmup_seconds: Optional[float] = None
+        # measurement-driven ladder state: per-size traffic histogram
+        # (fed by live dispatches, read by ``autotune``), per-rung
+        # compile/run costs (recorded by ``warmup``), and the active
+        # ladder (None = the pow2 default)
+        self.ladder: Optional[List[int]] = None
+        self.rung_costs: dict = {}
+        self._size_counts: dict = {}
+        self._in_warmup = False
+        # serving precision: explicit arg > the executor's declarative
+        # policy (Executor(precision=...) / DL4JTPU_PRECISION). For
+        # int8/fp8 the engine pins the quantized weights at construction
+        # and keeps the f32 signature for swap validation — candidates
+        # arrive in f32 and are quantized AFTER the gate, so the
+        # quantized shapes/dtypes never change and swaps stay
+        # zero-new-compiles (docs/QUANTIZATION.md).
+        execu = getattr(model, "_executor", None) or ex.get_executor()
+        self.precision = (resolve_precision(precision)
+                          if precision is not None else execu.precision)
+        self._raw_sig = None
+        if self.precision != "f32":
+            self._raw_sig = _tree_signature(model.params)
+            qp = execu.prepare_params(model.params, self.precision)
+            st = jax.tree_util.tree_map(jnp.asarray, model.state)
+            self._live = (qp, st)
         # registry-backed counters: /stats and /metrics read the SAME cells
         self.id = f"engine{next(InferenceEngine._ids)}"
         reg = get_registry()
@@ -151,7 +285,17 @@ class InferenceEngine:
             "dl4jtpu_model_swaps_total",
             "Weight hot-swaps applied with zero new XLA compiles.",
             ("engine",)).labels(**lab)
+        self._m_rungs = reg.gauge(
+            "dl4jtpu_serving_bucket_rungs",
+            "Rungs in the active bucket ladder (= compiled programs the "
+            "ladder needs; drops when autotune merges rungs).",
+            ("engine",)).labels(**lab)
         self._m_version.set(0.0)
+        self._m_rungs.set(float(len(bucket_ladder(self.max_batch,
+                                                  self.min_bucket))))
+        if self.precision != "f32":
+            record_weight_bytes(self.id, self.precision,
+                                tree_bytes(self._live[0]))
 
     @property
     def trace_count(self) -> int:
@@ -181,14 +325,28 @@ class InferenceEngine:
         weight references and finish on the old weights; subsequent
         dispatches see the new pair. Same shapes/dtypes → the cached jitted
         forward is reused, so a swap costs zero new XLA compiles. Returns
-        the new model version (``version`` or previous + 1)."""
+        the new model version (``version`` or previous + 1).
+
+        Under int8/fp8 precision the candidate still arrives in f32 (the
+        trainer/checkpoint format): it is validated against the ORIGINAL
+        f32 signature, then quantized — same quantized shapes/dtypes as
+        the live tree, so the zero-new-compiles invariant holds."""
         cur_p, cur_s = self._weights()
-        validate_swap(cur_p, params, "params")
+        if self._raw_sig is not None:
+            _validate_sig(self._raw_sig, _tree_signature(params), "params")
+        else:
+            validate_swap(cur_p, params, "params")
         if state is not None:
             validate_swap(cur_s, state, "state")
         # device-resident once, at swap time — numpy trees fresh from a
         # checkpoint zip would otherwise pay a host→device copy per request
         params = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.precision != "f32":
+            from deeplearning4j_tpu import exec as ex
+            execu = getattr(self.model, "_executor", None) \
+                or ex.get_executor()
+            params = execu.prepare_params(params, self.precision)
+            record_weight_bytes(self.id, self.precision, tree_bytes(params))
         state = (cur_s if state is None
                  else jax.tree_util.tree_map(jnp.asarray, state))
         with self._lock:
@@ -206,15 +364,22 @@ class InferenceEngine:
             return self._fwd
         model = self.model
 
+        # dequant-on-the-fly INSIDE the traced body: XLA fuses the
+        # codes→f32 scale-multiply into the consuming matmuls, so the
+        # weights live in HBM at int8/fp8 width and widen in registers.
+        # On the f32 path ``dequantize_tree`` is the identity on every
+        # leaf — the emitted program is byte-identical to before.
         if self._is_graph:
             def fwd(params, state, inputs, mask):
                 self._note_trace(inputs, mask)
+                params = dequantize_tree(params)
                 acts, _, _ = model._forward(params, state, inputs,
                                             train=False, rng=None)
                 return [acts[n] for n in model.conf.network_outputs]
         else:
             def fwd(params, state, inputs, mask):
                 self._note_trace(inputs, mask)
+                params = dequantize_tree(params)
                 act, _, _ = model._forward(params, state, inputs[0],
                                            train=False, rng=None, mask=mask)
                 return [act]
@@ -251,14 +416,20 @@ class InferenceEngine:
         than ``max_batch`` are chunked through the top bucket."""
         n = inputs[0].shape[0]
         if n > self.max_batch:
+            # each chunk recurses through THIS method, so the tail chunk
+            # (n % max_batch rows) re-buckets via bucket_for(tail) instead
+            # of padding to the full top bucket — its saved pad rows simply
+            # never hit the pad-waste counter below
             pieces = [self._dispatch(
                 [x[i:i + self.max_batch] for x in inputs],
                 None if mask is None else mask[i:i + self.max_batch])
                 for i in range(0, n, self.max_batch)]
             return [jnp.concatenate([p[j] for p in pieces])
                     for j in range(len(pieces[0]))]
+        if not self._in_warmup:
+            self._size_counts[n] = self._size_counts.get(n, 0) + 1
         with trace.span("bucket", n=n):
-            b = bucket_for(n, self.max_batch, self.min_bucket)
+            b = bucket_for(n, self.max_batch, self.min_bucket, self.ladder)
         with trace.span("pad", bucket=b):
             padded = [self._pad_rows(x, b) for x in inputs]
             mask_p = None if mask is None else self._pad_rows(mask, b)
@@ -323,22 +494,64 @@ class InferenceEngine:
         list of shapes for multi-input graphs. ``max_batch`` caps the ladder
         (default: the engine's max_batch). ``with_mask_len``: also compile
         the mask-carrying variants for (B, T=with_mask_len) masks.
-        Returns the list of bucket sizes compiled."""
+
+        Each rung is dispatched twice with the second run timed separately,
+        so ``rung_costs[b] = {"compile_s", "run_s"}`` records what the rung
+        actually cost — the measurements ``autotune(prune=True)`` uses to
+        merge rungs not worth their compile. Returns the bucket sizes
+        compiled (the ACTIVE ladder — autotuned if one was applied)."""
         from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
         setup_compile_cache()
         shapes = (example_shape if isinstance(example_shape, list)
                   else [example_shape])
-        ladder = bucket_ladder(min(max_batch or self.max_batch,
-                                   self.max_batch), self.min_bucket)
+        cap = min(max_batch or self.max_batch, self.max_batch)
+        ladder = [b for b in (self.ladder
+                              or bucket_ladder(cap, self.min_bucket))
+                  if b <= cap]
         t0 = time.perf_counter()
-        for b in ladder:
-            zeros = [jnp.zeros((b,) + tuple(s), dtype) for s in shapes]
-            outs = self._dispatch(zeros)
-            if with_mask_len is not None and not self._is_graph:
-                m = jnp.ones((b, with_mask_len), dtype)
-                outs = self._dispatch(zeros, m)
-        jax.block_until_ready(outs)
+        self._in_warmup = True    # warmup traffic must not skew autotune
+        try:
+            for b in ladder:
+                zeros = [jnp.zeros((b,) + tuple(s), dtype) for s in shapes]
+                ta = time.perf_counter()
+                jax.block_until_ready(self._dispatch(zeros))
+                tb = time.perf_counter()
+                jax.block_until_ready(self._dispatch(zeros))
+                tc = time.perf_counter()
+                self.rung_costs[b] = {
+                    "compile_s": max((tb - ta) - (tc - tb), 0.0),
+                    "run_s": tc - tb}
+                if with_mask_len is not None and not self._is_graph:
+                    m = jnp.ones((b, with_mask_len), dtype)
+                    jax.block_until_ready(self._dispatch(zeros, m))
+        finally:
+            self._in_warmup = False
         self.warmup_seconds = time.perf_counter() - t0
+        return ladder
+
+    def autotune(self, max_rungs: Optional[int] = None, apply: bool = True,
+                 prune: bool = False, counts: Optional[dict] = None,
+                 ) -> List[int]:
+        """Re-derive the bucket ladder from the traffic this engine has
+        actually served (the per-size histogram ``_dispatch`` records).
+
+        The DP (``autotune_ladder``) never pads more than pow2 and never
+        uses more rungs; ``prune=True`` additionally merges rungs whose
+        measured compile cost (from ``warmup``'s rung_costs) exceeds the
+        run-time their padding saves. ``apply=False`` just returns the
+        proposal. ``counts`` substitutes an external size histogram (e.g.
+        another engine's measured traffic) for this engine's own. Call
+        after a representative traffic window; already-compiled pow2
+        programs stay cached, so switching ladders mid-run only ever ADDS
+        at most len(new ladder) compiles."""
+        counts = dict(self._size_counts if counts is None else counts)
+        ladder = autotune_ladder(counts, self.max_batch, max_rungs,
+                                 self.min_bucket)
+        if prune and self.rung_costs:
+            ladder = prune_ladder(ladder, counts, self.rung_costs)
+        if apply:
+            self.ladder = ladder
+            self._m_rungs.set(float(len(ladder)))
         return ladder
 
     # --------------------------------------------------------------- stats
@@ -348,8 +561,14 @@ class InferenceEngine:
         pad = self._m_pad_rows.value
         return {"id": self.id,
                 "max_batch": self.max_batch,
-                "bucket_ladder": bucket_ladder(self.max_batch,
-                                               self.min_bucket),
+                "bucket_ladder": (list(self.ladder) if self.ladder
+                                  else bucket_ladder(self.max_batch,
+                                                     self.min_bucket)),
+                "ladder_autotuned": self.ladder is not None,
+                "rung_costs": {int(k): dict(v)
+                               for k, v in self.rung_costs.items()},
+                "precision": self.precision,
+                "weight_bytes": tree_bytes(self._weights()[0]),
                 "model_version": self._version,
                 "compiled_programs": self.trace_count,
                 "rows": int(rows),

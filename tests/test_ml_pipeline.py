@@ -5,13 +5,18 @@ import numpy as np
 
 from deeplearning4j_tpu import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
 from deeplearning4j_tpu.scaleout import (NetworkClassifier,
                                          AutoEncoderEstimator, Pipeline,
                                          NetworkModel)
 
 
 def _clf_conf():
-    return (NeuralNetConfiguration.builder().seed(7).list()
+    # updater pinned: the default SGD at its default rate deterministically
+    # under-trains these blobs in the epoch budget (plateaus ~0.8, below
+    # the score bars) — Adam reaches 1.0 on every scenario here
+    return (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list()
             .layer(DenseLayer(n_in=8, n_out=24, activation="relu"))
             .layer(OutputLayer(n_in=24, n_out=3, activation="softmax",
                                loss="mcxent"))
@@ -53,7 +58,8 @@ def test_classifier_sklearn_protocol_and_save_load(tmp_path):
 
 def test_autoencoder_transform_shape_and_pipeline():
     def ae_conf():
-        return (NeuralNetConfiguration.builder().seed(5).list()
+        return (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                .list()
                 .layer(DenseLayer(n_in=8, n_out=3, activation="tanh"))
                 .layer(OutputLayer(n_in=3, n_out=8, activation="identity",
                                    loss="mse"))
@@ -65,7 +71,8 @@ def test_autoencoder_transform_shape_and_pipeline():
     assert enc.shape == (160, 3)
 
     def clf_conf():
-        return (NeuralNetConfiguration.builder().seed(7).list()
+        return (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+                .list()
                 .layer(DenseLayer(n_in=3, n_out=16, activation="relu"))
                 .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
                                    loss="mcxent"))

@@ -1,0 +1,235 @@
+"""Low-precision serving: quantization parity + engine integration.
+
+The load-bearing claims pinned here (docs/QUANTIZATION.md):
+- per-channel int8/fp8 weight quantization round-trips within documented
+  per-layer error bars (int8 rel ≤ 1%, fp8-e4m3 rel ≤ 5%), and the f32
+  "quantization" is the identity on the SAME objects — the f32 serving
+  path stays bitwise-untouched;
+- an int8 tree is ≤ 0.30× the f32 bytes once matrices dominate;
+- engines under int8/fp8 serve within an end-to-end accuracy delta bar
+  of the f32 engine, while hot swaps still validate f32 candidates and
+  perform ZERO new XLA compiles (the quantize-behind-the-gate design);
+- each (model, precision) pair costs exactly ONE compiled decode-step
+  program, and bucketed serving compiles per bucket as before.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.quant import (QTensor, dequantize, dequantize_tree,
+                                      quant_error_report, quantize,
+                                      quantize_tree, resolve_precision,
+                                      tree_bytes)
+from deeplearning4j_tpu.serving.decode import DecodeEngine
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.replica import build_model
+
+
+def _net(seed=3, n_in=8, hidden=64, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _blobs(n=240, seed=0, d=8, k=3):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3
+    y = rs.randint(0, k, n)
+    X = centers[y] + rs.randn(n, d) * 0.5
+    return X.astype(np.float32), y
+
+
+# --------------------------------------------------------------- mechanism
+class TestQTensor:
+
+    def test_resolve_precision_aliases_and_rejects(self):
+        for alias in (None, "", "f32", "float32", "fp32", "none"):
+            assert resolve_precision(alias) == "f32"
+        for alias in ("int8", "i8", "INT8"):
+            assert resolve_precision(alias) == "int8"
+        for alias in ("fp8", "e4m3", "fp8_e4m3", "float8"):
+            assert resolve_precision(alias) == "fp8"
+        with pytest.raises(ValueError):
+            resolve_precision("int4")
+
+    @pytest.mark.parametrize("precision,rel_bar", [("int8", 0.01),
+                                                   ("fp8", 0.05)])
+    def test_roundtrip_error_bounds(self, precision, rel_bar):
+        rs = np.random.RandomState(0)
+        # mixed per-channel magnitudes — the case per-TENSOR scales fail
+        w = (rs.randn(64, 32) * np.logspace(-2, 1, 32)).astype(np.float32)
+        qt = quantize(jnp.asarray(w), precision)
+        assert isinstance(qt, QTensor)
+        assert qt.shape == w.shape
+        back = np.asarray(dequantize(qt))
+        rel = np.max(np.abs(back - w)) / np.max(np.abs(w))
+        assert rel <= rel_bar, rel
+
+    def test_zero_channel_is_exact_and_finite(self):
+        w = jnp.zeros((4, 3), jnp.float32)
+        for p in ("int8", "fp8"):
+            back = np.asarray(dequantize(quantize(w, p)))
+            assert np.all(back == 0) and np.all(np.isfinite(back))
+
+    def test_f32_is_identity_same_objects(self):
+        tree = {"W": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        assert quantize_tree(tree, "f32") is tree
+        # and dequantize of an unquantized tree keeps the same leaves
+        out = dequantize_tree(tree)
+        assert out["W"] is tree["W"] and out["b"] is tree["b"]
+
+    def test_tree_quantization_skips_vectors_and_exclusions(self):
+        tree = {"layer0": {"W": jnp.ones((8, 8)), "b": jnp.ones((8,))},
+                "head": {"W": jnp.ones((8, 2))}}
+        q = quantize_tree(tree, "int8", exclude=("head",))
+        assert isinstance(q["layer0"]["W"], QTensor)
+        assert not isinstance(q["layer0"]["b"], QTensor)   # 1-D: never
+        assert not isinstance(q["head"]["W"], QTensor)     # excluded
+
+    def test_int8_bytes_ratio(self):
+        rs = np.random.RandomState(1)
+        tree = {"W1": jnp.asarray(rs.randn(256, 256), jnp.float32),
+                "W2": jnp.asarray(rs.randn(256, 128), jnp.float32),
+                "b": jnp.zeros((256,), jnp.float32)}
+        f32 = tree_bytes(tree)
+        q = tree_bytes(quantize_tree(tree, "int8"))
+        assert q <= 0.30 * f32, (q, f32)
+
+    def test_error_report_shape(self):
+        tree = {"W": jnp.ones((8, 8)) * 0.5}
+        rep = quant_error_report(tree, quantize_tree(tree, "int8"))
+        assert "max" in rep and "rel_max" in rep
+        assert rep["rel_max"] <= 0.01
+
+    def test_qtensor_flows_through_jit(self):
+        w = jnp.asarray(np.random.RandomState(2).randn(16, 8), jnp.float32)
+        qt = quantize(w, "int8")
+
+        @jax.jit
+        def f(q, x):
+            return x @ dequantize(q)
+
+        x = jnp.ones((2, 16))
+        out = f(qt, x)
+        ref = x @ dequantize(qt)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+
+# ------------------------------------------------------- engine integration
+class TestQuantizedServing:
+
+    @pytest.mark.parametrize("precision,out_bar", [("int8", 0.02),
+                                                   ("fp8", 0.05)])
+    def test_engine_parity_and_weight_bytes(self, precision, out_bar):
+        net = _net(hidden=64)
+        X, _ = _blobs(64)
+        e32 = InferenceEngine(net, max_batch=64)
+        eq = InferenceEngine(net, max_batch=64, precision=precision)
+        y32 = e32.predict_host(X)
+        yq = eq.predict_host(X)
+        assert float(np.max(np.abs(yq - y32))) <= out_bar
+        assert eq.stats()["precision"] == precision
+        assert eq.stats()["weight_bytes"] < e32.stats()["weight_bytes"]
+
+    def test_f32_engine_path_is_bitwise_unchanged(self):
+        net = _net(seed=11)
+        X, _ = _blobs(32, seed=4)
+        plain = InferenceEngine(net, max_batch=32)
+        explicit = InferenceEngine(net, max_batch=32, precision="f32")
+        assert np.array_equal(plain.predict_host(X),
+                              explicit.predict_host(X))
+        assert np.array_equal(plain.predict_host(X),
+                              np.asarray(net.output(X)))
+
+    def test_eval_accuracy_delta_within_bar(self):
+        X, y = _blobs(240)
+        net = _net()
+        from deeplearning4j_tpu.data.dataset import DataSet
+        onehot = np.eye(3, dtype=np.float32)[y]
+        for _ in range(15):
+            net.fit(DataSet(X, onehot))
+        acc = {}
+        for precision in ("f32", "int8", "fp8"):
+            e = InferenceEngine(net, max_batch=256, precision=precision)
+            pred = np.argmax(e.predict_host(X), -1)
+            acc[precision] = float(np.mean(pred == y))
+        # documented bars (docs/QUANTIZATION.md): int8 ≤ 1%, fp8 ≤ 2%
+        assert abs(acc["int8"] - acc["f32"]) <= 0.01, acc
+        assert abs(acc["fp8"] - acc["f32"]) <= 0.02, acc
+
+    def test_swap_under_quantization_zero_new_compiles(self):
+        net = _net(seed=5)
+        X, _ = _blobs(16, seed=1)
+        e = InferenceEngine(net, max_batch=16, precision="int8")
+        e.predict_host(X)
+        before = e.trace_count
+        # candidate arrives in f32 (trainer/checkpoint format)
+        cand = jax.tree_util.tree_map(
+            lambda a: np.asarray(a) * 1.01, net.params)
+        v = e.swap_weights(cand)
+        assert v == 1
+        e.predict_host(X)
+        assert e.trace_count == before
+        # and a wrong-shape f32 candidate still rejects cleanly
+        from deeplearning4j_tpu.resilience.errors import WeightSwapError
+        bad = jax.tree_util.tree_map(
+            lambda a: np.zeros((2, 2), np.float32), cand)
+        with pytest.raises(WeightSwapError):
+            e.swap_weights(bad)
+
+    def test_decode_engine_one_program_per_precision(self):
+        net = build_model("charlstm")
+        e32 = DecodeEngine(net, slots=2, max_len=32).start()
+        e8 = DecodeEngine(net, slots=2, max_len=32,
+                          precision="int8").start()
+        try:
+            r32 = e32.generate([3, 1, 4], max_new_tokens=6)
+            r8 = e8.generate([3, 1, 4], max_new_tokens=6)
+        finally:
+            e32.stop()
+            e8.stop()
+        # one donated program each — quantization keys a separate program
+        # on ITS engine, never a second one
+        assert e32.trace_count == 1
+        assert e8.trace_count == 1
+        assert len(r8["tokens"]) == 6
+        assert e8.stats()["precision"] == "int8"
+        assert e8.stats()["weight_bytes"] < e32.stats()["weight_bytes"]
+
+    def test_decode_swap_under_quantization_zero_new_compiles(self):
+        net = build_model("charlstm")
+        e = DecodeEngine(net, slots=2, max_len=32, precision="int8").start()
+        try:
+            e.generate([3, 1, 4], max_new_tokens=4)
+            before = e.trace_count
+            e.swap_weights(jax.tree_util.tree_map(np.asarray, net.params))
+            out = e.generate([3, 1, 4], max_new_tokens=4)
+        finally:
+            e.stop()
+        assert e.trace_count == before
+        assert len(out["tokens"]) == 4
+
+    def test_executor_precision_policy_reaches_engines(self):
+        from deeplearning4j_tpu import exec as ex
+        old = ex.get_executor()
+        try:
+            ex.set_executor(ex.Executor(precision="int8"))
+            net = _net(seed=9)
+            e = InferenceEngine(net, max_batch=8)
+            assert e.precision == "int8"
+            assert e.stats()["precision"] == "int8"
+        finally:
+            ex.set_executor(old)

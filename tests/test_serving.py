@@ -411,3 +411,113 @@ def test_client_reconnects_when_connection_dies_mid_response():
         cli.close()
         lst.close()
     t.join(timeout=10)
+
+
+# ----------------------------------------------------- measured bucket ladder
+class TestMeasuredLadder:
+    """Measurement-driven bucket ladders (docs/QUANTIZATION.md §ladders):
+    the DP over observed traffic never pads more than pow2, never uses
+    more rungs, always keeps max_batch; the engine records its traffic and
+    per-rung costs and can switch ladders live."""
+
+    def test_bucket_for_with_explicit_ladder(self):
+        lad = [3, 7, 16]
+        assert bucket_for(1, 16, ladder=lad) == 3
+        assert bucket_for(3, 16, ladder=lad) == 3
+        assert bucket_for(4, 16, ladder=lad) == 7
+        assert bucket_for(9, 16, ladder=lad) == 16
+        assert bucket_for(99, 16, ladder=lad) == 16   # oversize: top rung
+        with pytest.raises(ValueError):
+            bucket_for(0, 16, ladder=lad)
+
+    def test_autotune_never_worse_than_pow2(self):
+        from deeplearning4j_tpu.serving.engine import autotune_ladder
+        rs = np.random.RandomState(0)
+        for max_batch in (16, 64, 256):
+            sizes = rs.randint(1, max_batch + 1, 12)
+            counts = {int(s): int(c) for s, c in
+                      zip(sizes, rs.randint(1, 200, len(sizes)))}
+            pow2 = bucket_ladder(max_batch)
+            lad = autotune_ladder(counts, max_batch)
+            assert lad[-1] == max_batch
+            assert len(lad) <= len(pow2)
+            pad_pow2 = sum(c * (bucket_for(s, max_batch) - s)
+                           for s, c in counts.items())
+            pad_auto = sum(c * (bucket_for(s, max_batch, ladder=lad) - s)
+                           for s, c in counts.items())
+            assert pad_auto <= pad_pow2, (lad, counts)
+
+    def test_autotune_exact_sizes_reach_zero_pad(self):
+        from deeplearning4j_tpu.serving.engine import autotune_ladder
+        counts = {5: 100, 9: 40, 13: 7}
+        lad = autotune_ladder(counts, 16)
+        pad = sum(c * (bucket_for(s, 16, ladder=lad) - s)
+                  for s, c in counts.items())
+        assert pad == 0
+        assert lad[-1] == 16
+
+    def test_autotune_empty_traffic_is_pow2(self):
+        from deeplearning4j_tpu.serving.engine import autotune_ladder
+        assert autotune_ladder({}, 64) == bucket_ladder(64)
+
+    def test_prune_ladder_merges_costly_rungs(self):
+        from deeplearning4j_tpu.serving.engine import prune_ladder
+        counts = {3: 1}            # one request near the bottom rung
+        ladder = [4, 8, 16]
+        # rung 4: compile costs 10s, padding 3→8 would cost ~4 rows of a
+        # 1ms/row program — pruning must merge rung 4 upward
+        costs = {4: {"compile_s": 10.0, "run_s": 0.004}}
+        out = prune_ladder(ladder, counts, costs)
+        assert 4 not in out and out[-1] == 16
+        # cheap compile is kept
+        costs = {4: {"compile_s": 1e-9, "run_s": 10.0}}
+        assert prune_ladder([4, 8, 16], counts, costs) == [4, 8, 16]
+
+    def test_engine_autotune_reduces_pad_and_respects_compiles(self):
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch=32)
+        X = np.zeros((32, 4), np.float32)
+        for n in (5, 5, 5, 9, 9, 13):
+            eng.predict_host(X[:n])
+        pow2_traces = eng.trace_count
+        proposal = eng.autotune(apply=True)
+        assert proposal[-1] == 32
+        assert len(proposal) <= len(bucket_ladder(32))
+        assert eng.stats()["ladder_autotuned"]
+        assert eng.stats()["bucket_ladder"] == proposal
+        pad_before = eng.stats()["pad_rows"]
+        for n in (5, 9, 13):
+            eng.predict_host(X[:n])
+        # exact-size rungs: zero NEW pad rows on the autotuned ladder
+        assert eng.stats()["pad_rows"] == pad_before
+        # switching ladders costs at most one compile per new rung
+        assert eng.trace_count <= pow2_traces + len(proposal)
+
+    def test_warmup_records_rung_costs(self):
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch=8)
+        ladder = eng.warmup((4,))
+        assert sorted(eng.rung_costs) == sorted(ladder)
+        for b in ladder:
+            assert eng.rung_costs[b]["run_s"] >= 0.0
+            assert eng.rung_costs[b]["compile_s"] >= 0.0
+        # warmup traffic must not pollute the autotune histogram
+        assert eng._size_counts == {}
+
+    def test_tail_chunks_rebucket_not_top_bucket(self):
+        """An oversize batch's TAIL goes through bucket_for(tail): 21 rows
+        at max_batch=8 run as 8+8+5 → the 5-row tail pads to bucket 8 only
+        by the pow2 rule (3 pad rows), never re-padded as a full top-bucket
+        chunk; the pad-waste metric counts exactly those rows."""
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch=8)
+        X = np.random.RandomState(0).randn(21, 4).astype(np.float32)
+        out = eng.predict_host(X)
+        assert out.shape[0] == 21
+        assert eng.stats()["pad_rows"] == 3          # only the 5→8 tail pad
+        # and with a ladder rung at the tail size, the tail pads ZERO
+        eng2 = InferenceEngine(net, max_batch=8)
+        eng2.ladder = [5, 8]
+        out2 = eng2.predict_host(X)
+        assert np.allclose(out2, out, atol=1e-6)
+        assert eng2.stats()["pad_rows"] == 0
