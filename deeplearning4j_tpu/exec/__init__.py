@@ -19,6 +19,8 @@ from deeplearning4j_tpu.exec.routing import (lstm_fwd_route,  # noqa: F401
                                              decode_attn_route,
                                              set_route, load_measurements,
                                              load_measurements_file)
+from deeplearning4j_tpu.exec.programs import (ProgramRegistry,  # noqa: F401
+                                              get_programs, is_registering)
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "build_mesh", "default_mesh",
@@ -27,4 +29,5 @@ __all__ = [
     "PARAMS", "STATE", "OPT", "REPL", "BATCH", "STEP_BATCH", "SLOTS",
     "lstm_fwd_route", "decode_attn_route", "set_route",
     "load_measurements", "load_measurements_file",
+    "ProgramRegistry", "get_programs", "is_registering",
 ]

@@ -272,6 +272,23 @@ class Executor:
         wrapped._exec_cache = cache
         return wrapped
 
+    # ------------------------------------------------------------- programs
+    @property
+    def programs(self):
+        """The process-wide compiled-program registry (``exec.programs``):
+        every compile site records cost/memory analysis of the programs
+        it built through this executor — ``GET /programs`` and the
+        ``dl4jtpu_program_*`` gauges read from here."""
+        from deeplearning4j_tpu.exec.programs import get_programs
+        return get_programs()
+
+    def register_program(self, caller, key, fn, args, compile_seconds=None):
+        """Record a program built by :meth:`jit` (single-device ``jax.jit``
+        results and mesh wrappers both work); see
+        ``programs.ProgramRegistry.record``."""
+        return self.programs.record(caller, key, fn, args,
+                                    compile_seconds=compile_seconds)
+
 
 # ------------------------------------------------------- process default
 _default_executor: Optional[Executor] = None

@@ -1,0 +1,211 @@
+"""Registry of compiled XLA programs with cost/memory introspection.
+
+Every compile site in the system — the bucketed serving engine, the
+continuous-batching decode engine, and both model containers — registers
+the program it just traced here, keyed by ``(caller, key)`` (e.g.
+``("engine0", "b32")`` or ``("mln0", "fit_scan_k64_b128")``). At
+registration the program is re-lowered and AOT-compiled to read XLA's
+own ``cost_analysis()`` (flops, bytes accessed) and
+``memory_analysis()`` (device footprint); the persistent compile cache
+(``util/compile_cache``) makes the second compile of an already-compiled
+signature cheap.
+
+What this buys:
+
+- ``dl4jtpu_program_{flops,bytes,memory_bytes,compile_seconds}`` gauges
+  labelled ``{caller,key}`` — MFU is now derivable from /metrics alone.
+- ``GET /programs`` on the inference server: the live program table.
+- ``bench.py`` MFU rows read flops from here instead of re-deriving them
+  with a private lowering helper.
+
+Re-lowering re-traces the python callable, which would double-count the
+callers' compile accounting (``_note_compile`` / ``_m_compiled.inc()``
+run inside traced bodies). Those sites consult :func:`is_registering`
+and skip their increment while a registration lowering is in flight.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+__all__ = ["ProgramRegistry", "get_programs", "is_registering"]
+
+
+_REGISTERING = threading.local()
+
+
+def is_registering() -> bool:
+    """True while this thread is re-lowering a program for registration —
+    compile-accounting side effects inside traced bodies must no-op."""
+    return getattr(_REGISTERING, "on", False)
+
+
+class _Registering:
+    __slots__ = ()
+
+    def __enter__(self):
+        _REGISTERING.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _REGISTERING.on = False
+        return False
+
+
+def _lowerable(fn):
+    """The object carrying ``.lower``: a plain ``jax.jit`` result, or one
+    of the jitted entries inside a mesh ``Executor.jit`` wrapper."""
+    if hasattr(fn, "lower"):
+        return fn
+    cache = getattr(fn, "_exec_cache", None)
+    if cache:
+        return next(iter(cache.values()))
+    return None
+
+
+def _analyze(jitted, args):
+    """(flops, bytes_accessed, memory_bytes, aot_compile_seconds) via the
+    AOT path; any missing analysis comes back None."""
+    t0 = time.perf_counter()
+    compiled = jitted.lower(*args).compile()
+    aot_s = time.perf_counter() - t0
+    flops = bytes_accessed = memory_bytes = None
+    try:
+        an = compiled.cost_analysis()
+        if isinstance(an, (list, tuple)):
+            an = an[0] if an else {}
+        if an:
+            f = an.get("flops")
+            flops = float(f) if f is not None else None
+            b = an.get("bytes accessed")
+            bytes_accessed = float(b) if b is not None else None
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        total = 0.0
+        found = False
+        for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                     "output_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                total += float(v)
+                found = True
+        if found:
+            memory_bytes = total
+    except Exception:
+        pass
+    return flops, bytes_accessed, memory_bytes, aot_s
+
+
+class ProgramRegistry:
+    """Process-wide table of registered programs (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}        # (caller, key) -> record dict
+        self._gauges = None
+
+    def _metric(self, record):
+        if self._gauges is None:
+            from deeplearning4j_tpu.monitor import get_registry
+            reg = get_registry()
+            self._gauges = {
+                "flops": reg.gauge(
+                    "dl4jtpu_program_flops",
+                    "XLA cost_analysis flops of the registered program",
+                    labelnames=("caller", "key")),
+                "bytes": reg.gauge(
+                    "dl4jtpu_program_bytes",
+                    "XLA cost_analysis bytes accessed",
+                    labelnames=("caller", "key")),
+                "memory_bytes": reg.gauge(
+                    "dl4jtpu_program_memory_bytes",
+                    "XLA memory_analysis device footprint "
+                    "(args + outputs + temps + code)",
+                    labelnames=("caller", "key")),
+                "compile_seconds": reg.gauge(
+                    "dl4jtpu_program_compile_seconds",
+                    "wall seconds of the compile-bearing call that "
+                    "produced the program (AOT relower time if unmeasured)",
+                    labelnames=("caller", "key")),
+            }
+        lbl = {"caller": record["caller"], "key": record["key"]}
+        for field, fam in self._gauges.items():
+            v = record.get(field)
+            if v is not None:
+                fam.labels(**lbl).set(v)
+
+    def record(self, caller: str, key: str, fn, args,
+               compile_seconds: Optional[float] = None) -> Optional[dict]:
+        """Register program ``(caller, key)``; re-registration of a known
+        key is a no-op (returns the existing record). Analysis failures
+        degrade to a record with None fields rather than raising into
+        the caller's hot path."""
+        caller, key = str(caller), str(key)
+        with self._lock:
+            existing = self._programs.get((caller, key))
+        if existing is not None:
+            return existing
+        jitted = _lowerable(fn)
+        if jitted is None:
+            return None
+        flops = bytes_accessed = memory_bytes = None
+        aot_s = None
+        try:
+            with _Registering():
+                flops, bytes_accessed, memory_bytes, aot_s = _analyze(
+                    jitted, args)
+        except Exception:
+            pass
+        record = {
+            "caller": caller,
+            "key": key,
+            "flops": flops,
+            "bytes": bytes_accessed,
+            "memory_bytes": memory_bytes,
+            "compile_seconds": (compile_seconds if compile_seconds is not None
+                                else aot_s),
+        }
+        with self._lock:
+            # lost a race: keep the first registration
+            existing = self._programs.setdefault((caller, key), record)
+        if existing is record:
+            try:
+                self._metric(record)
+            except Exception:
+                pass
+        return existing
+
+    def get(self, caller: str, key: str) -> Optional[dict]:
+        with self._lock:
+            return self._programs.get((str(caller), str(key)))
+
+    def last(self, caller: str) -> Optional[dict]:
+        """Most recently registered program of ``caller``."""
+        caller = str(caller)
+        with self._lock:
+            out = None
+            for (c, _), rec in self._programs.items():
+                if c == caller:
+                    out = rec
+            return out
+
+    def entries(self) -> list:
+        with self._lock:
+            return [dict(rec) for rec in self._programs.values()]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._programs.clear()
+
+
+_programs = ProgramRegistry()
+
+
+def get_programs() -> ProgramRegistry:
+    """The process-wide program registry (analog of
+    ``monitor.get_registry()``)."""
+    return _programs

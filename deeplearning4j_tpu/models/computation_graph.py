@@ -12,6 +12,7 @@ the whole graph.
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Optional, Dict, Any, List
 
@@ -36,6 +37,8 @@ from deeplearning4j_tpu.util.dtypes import (cast_floats as _cast_floats,
 
 
 class ComputationGraph:
+    _prog_ids = itertools.count()
+
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.params: Optional[Dict[str, Dict]] = None
@@ -58,6 +61,9 @@ class ComputationGraph:
         self._compile_count = 0       # train programs traced (see _note_compile)
         self._train_mon = None        # lazy TrainMonitor (metric children)
         self._exec = None             # execution core (lazy; exec/executor.py)
+        # per-instance caller id for the XLA program registry (/programs):
+        # a rebuilt graph gets fresh registry rows, never a stale hit
+        self._prog_caller = f"cg{next(ComputationGraph._prog_ids)}"
 
     @property
     def _executor(self):
@@ -257,7 +263,12 @@ class ComputationGraph:
 
     def _note_compile(self):
         # called from inside jitted train-step bodies: runs only while jit
-        # traces a NEW signature, i.e. exactly once per compiled program
+        # traces a NEW signature, i.e. exactly once per compiled program.
+        # Program-registry introspection re-lowers the same body (exec/
+        # programs.py) — that re-trace must not count as a fresh compile.
+        from deeplearning4j_tpu.exec.programs import is_registering
+        if is_registering():
+            return
         self._compile_count += 1
 
     @property
@@ -354,6 +365,17 @@ class ComputationGraph:
                          examples=n_steps * int(inputs_steps[0].shape[1]),
                          score=self._score,
                          compiled=self._compile_count - c0, path="scan")
+        if self._compile_count > c0:
+            # fresh XLA program: record its cost/memory analysis so /programs
+            # and the bench MFU column read measured numbers, not estimates.
+            # Lowering args are the donated call's OUTPUTS (same shapes).
+            self._executor.register_program(
+                self._prog_caller,
+                f"fit_scan_k{n_steps}_b{int(inputs_steps[0].shape[1])}",
+                self._scan_fit,
+                (self.params, self.state, self.opt_state, inputs_steps,
+                 labels_steps, jnp.asarray(self.iteration, jnp.int32)),
+                compile_seconds=time.perf_counter() - t0)
         if self.listeners:
             with trace.span("callback"):
                 for lst in self.listeners:
@@ -372,6 +394,16 @@ class ComputationGraph:
         ``checkpoint`` / ``resume_from``: crash-safe periodic saves and
         bitwise-identical continuation — same contract as
         MultiLayerNetwork.fit (docs/FAULT_TOLERANCE.md)."""
+        from deeplearning4j_tpu.monitor.profiling import profile_scope
+
+        # DL4JTPU_PROFILE=<dir> wraps the whole call in jax.profiler.trace
+        # (docs/OBSERVABILITY.md); unset, this is a plain passthrough
+        with profile_scope():
+            return self._fit_impl(data, labels, epochs, prefetch,
+                                  checkpoint, resume_from)
+
+    def _fit_impl(self, data, labels, epochs, prefetch, checkpoint,
+                  resume_from):
         from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 
         ckpt = None
@@ -638,6 +670,17 @@ class ComputationGraph:
                 jnp.asarray(self.iteration, jnp.int32), masks, label_masks)
             self._score = loss  # device scalar; host-read deferred to
                                 # get_score() (sync ~100ms on tunneled TPUs)
+            if self._compile_count > c0:
+                # fresh XLA program: expose its cost/memory analysis via the
+                # registry (/programs). Donated inputs → lower with outputs.
+                self._executor.register_program(
+                    self._prog_caller,
+                    f"train_step_b{int(inputs[0].shape[0])}",
+                    step,
+                    (self.params, self.state, self.opt_state, inputs, labels,
+                     jnp.asarray(self.iteration, jnp.int32), masks,
+                     label_masks),
+                    compile_seconds=time.perf_counter() - t0)
         self._last_fit_time = time.perf_counter() - t0
         self.iteration += 1
         self._epoch_batch += 1

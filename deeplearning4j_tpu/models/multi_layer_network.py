@@ -15,6 +15,7 @@ is rebinding, and buffers are donated so XLA updates in place.
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Optional, List, Any, Dict
 
@@ -39,6 +40,8 @@ from deeplearning4j_tpu.util.dtypes import (cast_floats as _cast_floats,
 
 
 class MultiLayerNetwork:
+    _prog_ids = itertools.count()
+
     def __init__(self, conf: MultiLayerConfiguration):
         conf.finalize()
         self.conf = conf
@@ -64,6 +67,9 @@ class MultiLayerNetwork:
         self._compile_count = 0       # train programs traced (see _note_compile)
         self._train_mon = None        # lazy TrainMonitor (metric children)
         self._exec = None             # execution core (lazy; exec/executor.py)
+        # per-instance caller id for the XLA program registry (/programs):
+        # a rebuilt net gets fresh registry rows, never a stale hit
+        self._prog_caller = f"mln{next(MultiLayerNetwork._prog_ids)}"
 
     @property
     def _executor(self):
@@ -235,7 +241,12 @@ class MultiLayerNetwork:
 
     def _note_compile(self):
         # called from inside jitted train-step bodies: runs only while jit
-        # traces a NEW signature, i.e. exactly once per compiled program
+        # traces a NEW signature, i.e. exactly once per compiled program.
+        # Program-registry introspection re-lowers the same body (exec/
+        # programs.py) — that re-trace must not count as a fresh compile.
+        from deeplearning4j_tpu.exec.programs import is_registering
+        if is_registering():
+            return
         self._compile_count += 1
 
     @property
@@ -340,6 +351,17 @@ class MultiLayerNetwork:
                          examples=int(xs.shape[0]) * int(xs.shape[1]),
                          score=self._score,
                          compiled=self._compile_count - c0, path="scan")
+        if self._compile_count > c0:
+            # fresh XLA program: record its cost/memory analysis so /programs
+            # and the bench MFU column read measured numbers, not estimates.
+            # Lowering args are the donated call's OUTPUTS (same shapes).
+            self._executor.register_program(
+                self._prog_caller,
+                f"fit_scan_k{int(xs.shape[0])}_b{int(xs.shape[1])}",
+                self._scan_fit,
+                (self.params, self.state, self.opt_state, xs, ys,
+                 jnp.asarray(self.iteration, jnp.int32)),
+                compile_seconds=time.perf_counter() - t0)
         if self.listeners:
             with trace.span("callback"):
                 for lst in self.listeners:
@@ -380,6 +402,16 @@ class MultiLayerNetwork:
         shuffles land where the uninterrupted run left them) and the
         partial epoch skips the batches already trained. Requires
         resettable iterator data (docs/FAULT_TOLERANCE.md)."""
+        from deeplearning4j_tpu.monitor.profiling import profile_scope
+
+        # DL4JTPU_PROFILE=<dir> wraps the whole call in jax.profiler.trace
+        # (docs/OBSERVABILITY.md); unset, this is a plain passthrough
+        with profile_scope():
+            return self._fit_impl(data, labels, epochs, prefetch,
+                                  checkpoint, resume_from)
+
+    def _fit_impl(self, data, labels, epochs, prefetch, checkpoint,
+                  resume_from):
         from deeplearning4j_tpu.data.dataset import DataSet
 
         ckpt = None

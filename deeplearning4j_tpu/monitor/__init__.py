@@ -12,20 +12,41 @@ Two stores, one subsystem:
   loop (wait/fetch/h2d/step/callback) and the serving path
   (enqueue/bucket/pad/device/readback).
 
-Both are cheap enough to leave on (see the bench's
-``observability_overhead`` row); tracing is opt-in via
-``trace.enable()`` / ``DL4JTPU_TRACE``. Metric name catalog and usage in
-docs/OBSERVABILITY.md.
+Fleet additions (docs/OBSERVABILITY.md):
+
+- ``tracing.TraceContext`` — Dapper-style trace identity minted at the
+  router, propagated via ``x-trace-context``; tracer timestamps share
+  the wall-clock epoch so ``collect.collect_fleet_trace`` can merge
+  every process's ring buffer into ONE Perfetto document.
+- ``slo.BurnRateSLO`` — multi-window (5 m / 1 h) error-budget burn-rate
+  health, wired into router and replica ``/healthz``.
+- ``profiling`` — ``POST /admin/profile`` around live traffic and
+  ``DL4JTPU_PROFILE=dir`` around ``fit()``.
+
+Both stores are cheap enough to leave on (see the bench's
+``observability`` row); tracing is opt-in via ``trace.enable()`` /
+``DL4JTPU_TRACE``. Metric name catalog and usage in
+docs/OBSERVABILITY.md (linted by tools/lint_metrics.py).
 """
 
 from deeplearning4j_tpu.monitor.metrics import (
     Counter, Gauge, Histogram, MetricsRegistry, get_registry,
     set_metrics_enabled, DEFAULT_LATENCY_BUCKETS, DEFAULT_STEP_BUCKETS)
-from deeplearning4j_tpu.monitor.tracing import Tracer, trace, get_tracer
+from deeplearning4j_tpu.monitor.tracing import (
+    Tracer, trace, get_tracer,
+    TraceContext, set_context, get_context, trace_context)
+from deeplearning4j_tpu.monitor.slo import BurnRateSLO, SLOState
+from deeplearning4j_tpu.monitor.collect import collect_fleet_trace, merge_docs
+from deeplearning4j_tpu.monitor.profiling import (
+    start_profile, profile_status, profile_scope)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "set_metrics_enabled",
     "DEFAULT_LATENCY_BUCKETS", "DEFAULT_STEP_BUCKETS",
     "Tracer", "trace", "get_tracer",
+    "TraceContext", "set_context", "get_context", "trace_context",
+    "BurnRateSLO", "SLOState",
+    "collect_fleet_trace", "merge_docs",
+    "start_profile", "profile_status", "profile_scope",
 ]

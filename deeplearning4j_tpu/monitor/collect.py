@@ -1,0 +1,92 @@
+"""Fleet trace collection: N ring buffers → ONE Perfetto document.
+
+Every process in the serving tier exposes its tracer's ring buffer at
+``GET /trace`` (router and replicas alike). Because all tracers anchor
+timestamps to the shared wall-clock epoch (``monitor/tracing.py``) and
+every span carries the router-minted ``trace_id``, concatenating the
+buffers *is* the merge: the router's ``route``/``attempt`` spans and
+each replica's ``http_request → enqueue → bucket → device → readback``
+chain land on one timeline, grouped per process by the ``process_name``
+metadata events each export carries.
+
+The collector discovers replicas from the router's ``/stats`` (the
+``replicas`` table is keyed by upstream URL), pulls every ``/trace``,
+rebases timestamps to the earliest event (Perfetto prefers small ts),
+and writes a single Chrome trace-event JSON. One command::
+
+    python tools/collect_trace.py http://localhost:9400 -o /tmp/fleet.json
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Iterable, Optional
+
+__all__ = ["fetch_json", "collect_fleet_trace", "merge_docs"]
+
+
+def fetch_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def merge_docs(docs: Iterable[dict], rebase: bool = True) -> dict:
+    """Merge Chrome trace-event documents into one.
+
+    Metadata (``M``) events are kept per pid and deduplicated; timed
+    events are pooled, optionally rebased so the earliest timestamp
+    becomes 0, and sorted."""
+    meta, events, seen_meta = [], [], set()
+    for doc in docs:
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") == "M":
+                key = (ev.get("pid"), ev.get("name"),
+                       json.dumps(ev.get("args", {}), sort_keys=True))
+                if key not in seen_meta:
+                    seen_meta.add(key)
+                    meta.append(ev)
+            elif "ts" in ev:
+                events.append(ev)
+    if rebase and events:
+        t0 = min(ev["ts"] for ev in events)
+        events = [{**ev, "ts": ev["ts"] - t0} for ev in events]
+    events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def collect_fleet_trace(router_url: str,
+                        extra_urls: Iterable[str] = (),
+                        path: Optional[str] = None,
+                        timeout: float = 10.0,
+                        rebase: bool = True) -> dict:
+    """Pull ``/trace`` from the router and every replica it routes to,
+    merge, and (optionally) write to ``path``.
+
+    ``router_url`` may also be a plain replica — anything serving
+    ``/trace``; replica discovery just comes up empty. ``extra_urls``
+    adds processes the router does not know about (e.g. the online
+    learning service). Unreachable members are skipped, not fatal: a
+    fleet trace with one replica missing is still a fleet trace."""
+    base = router_url.rstrip("/")
+    urls = [base]
+    try:
+        stats = fetch_json(base + "/stats", timeout=timeout)
+        urls.extend(u.rstrip("/") for u in
+                    sorted(stats.get("replicas", {})))
+    except Exception:
+        pass
+    urls.extend(u.rstrip("/") for u in extra_urls)
+    docs, pulled = [], []
+    for u in dict.fromkeys(urls):       # dedupe, keep order
+        try:
+            docs.append(fetch_json(u + "/trace", timeout=timeout))
+            pulled.append(u)
+        except Exception:
+            continue
+    doc = merge_docs(docs, rebase=rebase)
+    doc["collectedFrom"] = pulled
+    if path:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

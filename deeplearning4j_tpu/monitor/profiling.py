@@ -1,0 +1,99 @@
+"""On-demand device profiling around live traffic and training.
+
+Two entry points over ``jax.profiler``:
+
+- ``POST /admin/profile {"seconds": S, "dir": D}`` on the inference
+  server calls :func:`start_profile`, which starts ``jax.profiler`` and
+  stops it from a timer thread ``S`` seconds later — live traffic keeps
+  flowing and lands inside the captured trace. One session at a time per
+  process; a second request while one is running is rejected.
+- ``DL4JTPU_PROFILE=/dir python train.py`` wraps the whole ``fit()``
+  call via :func:`profile_scope` in both model containers.
+
+Everything degrades to a no-op (with the reason reported) when the
+installed jax has no usable profiler — the serving path must never 500
+because profiling is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = ["start_profile", "profile_status", "profile_scope",
+           "PROFILE_ENV"]
+
+PROFILE_ENV = "DL4JTPU_PROFILE"
+
+_lock = threading.Lock()
+_active = None        # {"dir", "seconds", "started_at"} while running
+
+
+def profile_status() -> dict:
+    with _lock:
+        if _active is None:
+            return {"profiling": False}
+        return {"profiling": True, **_active}
+
+
+def start_profile(log_dir: str, seconds: float = 5.0) -> dict:
+    """Start a timed ``jax.profiler`` capture into ``log_dir``.
+
+    Returns the session descriptor immediately (the stop runs on a
+    daemon timer thread). Raises ``RuntimeError`` if a session is
+    already running or the profiler cannot start."""
+    seconds = float(seconds)
+    if not (0.0 < seconds <= 600.0):
+        raise ValueError(f"seconds must be in (0, 600], got {seconds}")
+    if not log_dir:
+        raise ValueError("dir is required")
+    global _active
+    with _lock:
+        if _active is not None:
+            raise RuntimeError("a profiling session is already running")
+        _active = {"dir": str(log_dir), "seconds": seconds,
+                   "started_at": time.time()}
+    try:
+        import jax
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(str(log_dir))
+    except Exception as e:
+        with _lock:
+            _active = None
+        raise RuntimeError(f"profiler unavailable: {e}")
+
+    def _stop():
+        global _active
+        time.sleep(seconds)
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        with _lock:
+            _active = None
+
+    threading.Thread(target=_stop, name="profile-stop", daemon=True).start()
+    return {"profiling": str(log_dir), "seconds": seconds}
+
+
+@contextmanager
+def profile_scope(env: str = PROFILE_ENV):
+    """Wrap a block in ``jax.profiler.trace(dir)`` when ``$DL4JTPU_PROFILE``
+    names a directory; a plain pass-through otherwise (including when the
+    profiler itself is unusable)."""
+    log_dir = os.environ.get(env, "").strip()
+    if not log_dir:
+        yield
+        return
+    try:
+        import jax
+        os.makedirs(log_dir, exist_ok=True)
+        cm = jax.profiler.trace(log_dir)
+    except Exception:
+        yield
+        return
+    with cm:
+        yield

@@ -2,20 +2,30 @@
 
 The per-step timeline half of the observability subsystem (fleet counters
 are ``monitor/metrics.py``). Spans follow the Dapper model (Sigelman et
-al., 2010) collapsed to one process: nestable named intervals recorded
-per thread, serialized as ``B``/``E`` (duration begin/end) events in the
-Chrome trace-event format — load the exported file straight into
-Perfetto (https://ui.perfetto.dev) or ``chrome://tracing`` and the
-``train_step`` spans visually nest their ``wait``/``fetch``/``h2d``/
-``step``/``callback`` children; the serving path shows
+al., 2010): nestable named intervals recorded per thread, serialized as
+``B``/``E`` (duration begin/end) events in the Chrome trace-event format
+— load the exported file straight into Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing`` and the ``train_step``
+spans visually nest their ``wait``/``fetch``/``h2d``/``step``/
+``callback`` children; the serving path shows
 ``enqueue``/``bucket``/``pad``/``device``/``readback``.
+
+Fleet tracing: timestamps are anchored to the unix epoch (wall clock) so
+spans recorded by *different processes* — the router, each replica
+subprocess — merge onto one timeline. A :class:`TraceContext` minted at
+the router rides the ``x-trace-context`` HTTP header into every replica;
+while a context is installed (thread-local), every span records its
+``trace_id`` so a collected fleet document can be filtered to one
+request's path end to end. ``monitor/collect.py`` pulls each process's
+ring buffer over ``GET /trace`` and emits the single merged document.
 
 Overhead discipline: tracing is OFF by default; a disabled tracer's
 ``span()`` returns one shared no-op context manager (no allocation, no
-clock read). Enabled, each span costs two ``perf_counter`` reads and two
-dict appends into a bounded ring buffer (old events are dropped, the
-process never grows without bound). The bench's ``observability_overhead``
-row pins the cost of both states.
+clock read). Enabled, argless spans are cached per name (no per-call
+allocation); each span costs two ``perf_counter`` reads and two dict
+appends into a bounded ring buffer (old events are dropped, the process
+never grows without bound). The bench's ``observability`` row pins the
+cost of both states.
 
 Enable via code (``trace.enable()``) or environment::
 
@@ -33,9 +43,83 @@ import time
 from collections import deque
 from typing import Optional
 
-__all__ = ["Tracer", "trace", "get_tracer"]
+__all__ = [
+    "Tracer", "trace", "get_tracer",
+    "TraceContext", "set_context", "get_context", "trace_context",
+]
 
 
+# ------------------------------------------------------------- context
+class TraceContext:
+    """Dapper-style trace identity carried across process boundaries.
+
+    ``trace_id`` names the whole request tree (the router mints it from
+    the request id); ``parent`` names the span that caused this process
+    to do work (e.g. the router attempt ``req-...#a1``). Serialized as
+    the ``x-trace-context`` header: ``trace_id`` or ``trace_id;parent``.
+    """
+
+    __slots__ = ("trace_id", "parent")
+
+    def __init__(self, trace_id: str, parent: str = ""):
+        self.trace_id = trace_id
+        self.parent = parent
+
+    def child(self, parent: str) -> "TraceContext":
+        return TraceContext(self.trace_id, parent)
+
+    def to_header(self) -> str:
+        return f"{self.trace_id};{self.parent}" if self.parent else self.trace_id
+
+    @classmethod
+    def from_header(cls, value) -> Optional["TraceContext"]:
+        if not value:
+            return None
+        value = value.strip()
+        if not value:
+            return None
+        trace_id, _, parent = value.partition(";")
+        return cls(trace_id, parent)
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"TraceContext({self.trace_id!r}, parent={self.parent!r})"
+
+
+_CTX = threading.local()
+
+
+def set_context(ctx: Optional[TraceContext]) -> None:
+    """Install ``ctx`` as this thread's current trace context."""
+    _CTX.ctx = ctx
+
+
+def get_context() -> Optional[TraceContext]:
+    return getattr(_CTX, "ctx", None)
+
+
+class _CtxScope:
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = getattr(_CTX, "ctx", None)
+        _CTX.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _CTX.ctx = self._prev
+        return False
+
+
+def trace_context(ctx: Optional[TraceContext]) -> _CtxScope:
+    """``with trace_context(ctx): ...`` — install for a scope, restoring
+    the previous context on exit (re-entrant, per-thread)."""
+    return _CtxScope(ctx)
+
+
+# ---------------------------------------------------------------- spans
 class _NullSpan:
     """Shared no-op context manager returned while tracing is disabled."""
 
@@ -63,9 +147,17 @@ class _Span:
         tr = self._tr
         ev = {"ph": "B", "name": self._name, "pid": tr._pid,
               "tid": threading.get_ident(),
-              "ts": (time.perf_counter() - tr._t0) * 1e6}
-        if self._args:
-            ev["args"] = self._args
+              "ts": (tr._epoch + time.perf_counter()) * 1e6}
+        args = self._args
+        ctx = getattr(_CTX, "ctx", None)
+        if ctx is not None:
+            # never mutate self._args: argless spans are cached + shared
+            args = dict(args) if args else {}
+            args["trace_id"] = ctx.trace_id
+            if ctx.parent:
+                args["parent"] = ctx.parent
+        if args:
+            ev["args"] = args
         tr._events.append(ev)
         return self
 
@@ -74,7 +166,7 @@ class _Span:
         tr._events.append(
             {"ph": "E", "name": self._name, "pid": tr._pid,
              "tid": threading.get_ident(),
-             "ts": (time.perf_counter() - tr._t0) * 1e6})
+             "ts": (tr._epoch + time.perf_counter()) * 1e6})
         return False
 
 
@@ -84,13 +176,23 @@ class Tracer:
     ``capacity`` bounds memory: a deque(maxlen) of event dicts — at the
     default 200k events (~100k spans) a steady-state training loop keeps
     the most recent few thousand steps, which is what a stall
-    investigation actually looks at."""
+    investigation actually looks at.
+
+    Timestamps are wall-clock microseconds (``time.time()`` anchored
+    once, advanced by ``perf_counter`` so they stay monotonic within the
+    process): every process shares the epoch, which is what lets
+    ``monitor/collect.py`` merge ring buffers from N processes onto one
+    Perfetto timeline."""
 
     def __init__(self, capacity: int = 200_000, enabled: bool = False):
-        self._events = deque(maxlen=int(capacity))
+        self._capacity = int(capacity)
+        self._events = deque(maxlen=self._capacity)
         self._enabled = bool(enabled)
         self._pid = os.getpid()
-        self._t0 = time.perf_counter()
+        # wall-clock anchor: ts = (_epoch + perf_counter()) seconds
+        self._epoch = time.time() - time.perf_counter()
+        self._process_name = ""
+        self._argless = {}
 
     @property
     def enabled(self) -> bool:
@@ -100,8 +202,20 @@ class Tracer:
         self._enabled = bool(on)
         return self
 
+    def set_process_name(self, name: str) -> "Tracer":
+        """Name this process's track in merged fleet traces (emitted as a
+        Chrome ``process_name`` metadata event on export)."""
+        self._process_name = str(name)
+        return self
+
+    @property
+    def process_name(self) -> str:
+        return self._process_name
+
     def clear(self) -> "Tracer":
-        self._events.clear()
+        # rebind rather than .clear(): a concurrent span/instant append
+        # lands harmlessly in the old deque instead of racing the wipe
+        self._events = deque(maxlen=self._capacity)
         return self
 
     def span(self, name: str, **args):
@@ -109,7 +223,14 @@ class Tracer:
         tracing returns a shared no-op (near-zero cost)."""
         if not self._enabled:
             return _NULL_SPAN
-        return _Span(self, name, args or None)
+        if not args:
+            # argless spans (the hot-path kind) are immutable: cache one
+            # instance per name instead of allocating per call
+            s = self._argless.get(name)
+            if s is None:
+                s = self._argless[name] = _Span(self, name, None)
+            return s
+        return _Span(self, name, args)
 
     def instant(self, name: str, **args):
         """Point-in-time marker (Chrome ``i`` event)."""
@@ -117,7 +238,11 @@ class Tracer:
             return
         ev = {"ph": "i", "name": name, "pid": self._pid,
               "tid": threading.get_ident(), "s": "t",
-              "ts": (time.perf_counter() - self._t0) * 1e6}
+              "ts": (self._epoch + time.perf_counter()) * 1e6}
+        ctx = getattr(_CTX, "ctx", None)
+        if ctx is not None:
+            args = dict(args) if args else {}
+            args["trace_id"] = ctx.trace_id
         if args:
             ev["args"] = args
         self._events.append(ev)
@@ -127,10 +252,34 @@ class Tracer:
 
     def export(self, path: Optional[str] = None) -> dict:
         """The Chrome trace-event document; written to ``path`` as JSON
-        when given. Events are sorted by timestamp so a ring-buffer wrap
-        (which may drop a ``B`` while keeping its ``E``) still loads."""
-        doc = {"traceEvents": sorted(self._events, key=lambda e: e["ts"]),
-               "displayTimeUnit": "ms"}
+        when given.
+
+        Events are sorted by timestamp, and ``E`` events whose matching
+        ``B`` fell off the ring (a wrap keeps the end of a span whose
+        begin was dropped) are removed — an unbalanced ``E`` makes
+        Perfetto close the *wrong* enclosing span, mis-nesting the whole
+        track. A ``B`` without an ``E`` (span still open) is fine and is
+        kept."""
+        events = sorted(self._events, key=lambda e: e["ts"])
+        kept, depth = [], {}
+        for ev in events:
+            ph = ev["ph"]
+            if ph == "B":
+                key = (ev["pid"], ev["tid"])
+                depth[key] = depth.get(key, 0) + 1
+            elif ph == "E":
+                key = (ev["pid"], ev["tid"])
+                d = depth.get(key, 0)
+                if d <= 0:
+                    continue  # orphan E: its B was dropped by the ring
+                depth[key] = d - 1
+            kept.append(ev)
+        meta = []
+        if self._process_name:
+            meta.append({"ph": "M", "name": "process_name",
+                         "pid": self._pid, "tid": 0,
+                         "args": {"name": self._process_name}})
+        doc = {"traceEvents": meta + kept, "displayTimeUnit": "ms"}
         if path:
             with open(path, "w") as f:
                 json.dump(doc, f)

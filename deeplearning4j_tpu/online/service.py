@@ -120,5 +120,14 @@ class OnlineLearningService:
     # -- health ------------------------------------------------------------
 
     def health_info(self) -> Optional[dict]:
-        """InferenceServer ``health_hook`` delegate."""
-        return self.trainer.health_info()
+        """InferenceServer ``health_hook`` delegate.
+
+        Healthy (None) passes through untouched so the server's own checks
+        (including the SLO burn-rate gate) decide the final status; a
+        degraded trainer report is annotated with the promoted version so
+        /healthz tells the operator WHICH deployment was live while the
+        stream went quiet."""
+        info = self.trainer.health_info()
+        if info is None:
+            return None
+        return dict(info, online_version=self.deployer.version)

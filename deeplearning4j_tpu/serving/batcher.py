@@ -36,6 +36,7 @@ import numpy as np
 
 from deeplearning4j_tpu.monitor import (
     DEFAULT_LATENCY_BUCKETS, get_registry, trace)
+from deeplearning4j_tpu.monitor import tracing
 from deeplearning4j_tpu.resilience.errors import (
     BatcherStoppedError, DeadlineExceededError, ServerOverloadedError)
 
@@ -160,7 +161,9 @@ class MicroBatcher:
         t0 = time.perf_counter()
         expires = None if deadline_ms is None else t0 + deadline_ms / 1000.0
         fut: Future = Future()
-        item = (x, fut, t0, expires)
+        # the submitting thread's trace context rides the queue item so the
+        # worker can stamp the device spans with the request's trace_id
+        item = (x, fut, t0, expires, tracing.get_context())
         give_up_at = (None if self.submit_timeout is None
                       else t0 + self.submit_timeout)
         with trace.span("enqueue", rows=int(x.shape[0])):
@@ -240,12 +243,16 @@ class MicroBatcher:
             try:
                 merged = (batch[0][0] if len(batch) == 1
                           else np.concatenate([b[0] for b in batch]))
-                out = self.engine.predict_host(merged)
+                # the merged device call runs under the first rider's trace
+                # context (one call serves many requests; Perfetto shows the
+                # co-travellers via their own enqueue spans)
+                with tracing.trace_context(batch[0][4]):
+                    out = self.engine.predict_host(merged)
                 if isinstance(out, list):   # multi-output graph: first head
                     out = out[0]
                 ofs = 0
                 done = time.perf_counter()
-                for x, fut, t0, _ in batch:
+                for x, fut, t0, _, _ in batch:
                     fut.set_result(out[ofs:ofs + x.shape[0]])
                     self._m_latency.observe(done - t0)
                     ofs += x.shape[0]

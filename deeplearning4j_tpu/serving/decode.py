@@ -265,7 +265,9 @@ class DecodeEngine:
         """ONE iteration for all S slots. All arguments are (S,)-shaped, so
         every call shares a single XLA program; scheduling decisions ride in
         as data (masks), never as shapes."""
-        self._m_compiled.inc()   # traced-only: exact compiled-program count
+        from deeplearning4j_tpu.exec.programs import is_registering
+        if not is_registering():
+            self._m_compiled.inc()   # traced-only: exact compiled-program count
         # dequant-on-the-fly (identity on the f32 path): int8/fp8 weights
         # stream from HBM at quantized width every step — the decode step
         # is weight-bandwidth-bound, so this is where low precision pays
@@ -357,12 +359,28 @@ class DecodeEngine:
         f = np.zeros(S, bool)
         t0 = time.perf_counter()
         params, state = self._weights()
+        c0 = self._m_compiled.value
         tok, self._dstate = self._step(
             params, state, self._dstate, z, z, f, f,
             np.zeros(S, np.uint32), np.zeros(S, np.float32), z)
         jax.block_until_ready(tok)
         self.warmup_seconds = time.perf_counter() - t0
+        if self._m_compiled.value > c0:
+            self._register_program(params, state,
+                                   (z, z, f, f, np.zeros(S, np.uint32),
+                                    np.zeros(S, np.float32), z),
+                                   self.warmup_seconds)
         return self.warmup_seconds
+
+    def _register_program(self, params, state, step_args, wall):
+        """Record the (single) decode-step program's cost/memory analysis
+        in the process program registry (``GET /programs``, MFU gauges).
+        Uses the post-step ``self._dstate`` — same shapes as the donated
+        input state."""
+        from deeplearning4j_tpu.exec.programs import get_programs
+        get_programs().record(self.id, "step", self._step,
+                              (params, state, self._dstate) + tuple(step_args),
+                              compile_seconds=wall)
 
     # ------------------------------------------------------------ scheduler
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -442,12 +460,17 @@ class DecodeEngine:
                 topk[i] = r.top_k
             t0 = time.perf_counter()
             params, state = self._weights()
+            c0 = self._m_compiled.value
             with trace.span("decode_step", active=len(live)):
                 nt, self._dstate = self._step(
                     params, state, self._dstate,
                     tokens, pos, reset, active, seeds, temps, topk)
                 nt = np.asarray(nt)
             dt = time.perf_counter() - t0
+            if self._m_compiled.value > c0:
+                self._register_program(
+                    params, state,
+                    (tokens, pos, reset, active, seeds, temps, topk), dt)
             self._decode_seconds += dt
             self._m_steps.inc()
             self._m_occupancy.set(len(live))

@@ -393,7 +393,11 @@ class InferenceEngine:
 
     def _note_trace(self, inputs, mask):
         # runs only while jit traces a NEW (shape, dtype, mask-presence)
-        # signature — i.e. exactly once per compiled program
+        # signature — i.e. exactly once per compiled program. Registration
+        # relowers the same body; that trace must not count twice.
+        from deeplearning4j_tpu.exec.programs import is_registering
+        if is_registering():
+            return
         key = (tuple((tuple(x.shape), str(x.dtype)) for x in inputs),
                None if mask is None else (tuple(mask.shape), str(mask.dtype)))
         self._m_compiled.inc()
@@ -435,7 +439,17 @@ class InferenceEngine:
             mask_p = None if mask is None else self._pad_rows(mask, b)
         with trace.span("device", bucket=b):
             params, state = self._weights()
+            c0 = self.trace_count
+            t0 = time.perf_counter()
             outs = self._forward_fn()(params, state, padded, mask_p)
+        if self.trace_count > c0:
+            # a fresh program was traced: register its cost/memory analysis
+            # (the relower hits the compile cache; guarded, off-hot-path)
+            from deeplearning4j_tpu.exec.programs import get_programs
+            key = f"b{b}" if mask_p is None else f"b{b}_mask"
+            get_programs().record(
+                self.id, key, self._fwd, (params, state, padded, mask_p),
+                compile_seconds=time.perf_counter() - t0)
         self._m_rows.inc(n)
         self._m_pad_rows.inc(b - n)
         return [o[:n] for o in outs]

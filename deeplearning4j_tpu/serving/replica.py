@@ -122,6 +122,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         choices=("f32", "int8", "fp8"),
                         help="serving precision for both engines (default: "
                              "the executor policy / DL4JTPU_PRECISION)")
+    parser.add_argument("--trace", action="store_true",
+                        help="enable span tracing (also via DL4JTPU_TRACE); "
+                             "the ring buffer is served at GET /trace for "
+                             "fleet collection")
     args = parser.parse_args(argv)
 
     # CPU platform before anything touches a backend: replicas are test
@@ -131,6 +135,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
     setup_compile_cache()       # restart-in-place must not recompile
+
+    from deeplearning4j_tpu.monitor import trace as _trace
+    if args.trace:
+        _trace.enable(True)
 
     srv = build_server(args.model, port=args.port, slots=args.slots,
                        max_len=args.max_len, max_queue=args.max_queue,
@@ -165,6 +173,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(tmp, "w") as f:
             f.write(str(srv.port))
         os.replace(tmp, args.port_file)      # atomic: parent never reads ""
+    # name this process's track in merged fleet traces
+    _trace.set_process_name(f"replica:{args.model}@{srv.port}")
     print(f"REPLICA_READY port={srv.port} pid={os.getpid()} "
           f"model={args.model}", flush=True)
 
@@ -202,7 +212,7 @@ class ReplicaProcess:
                  slots: int = 4, max_len: int = 64,
                  chaos: bool = True, warmup: bool = True,
                  name: str = "replica", checkpoint: Optional[str] = None,
-                 precision: Optional[str] = None):
+                 precision: Optional[str] = None, trace: bool = False):
         self.workdir = workdir
         self.model = model
         self.slots = slots
@@ -211,6 +221,8 @@ class ReplicaProcess:
         self.warmup = warmup
         self.name = name
         self.precision = precision
+        # span tracing in the child (GET /trace serves its ring buffer)
+        self.trace = trace
         # mutable: rolling restarts set this to the latest promoted
         # checkpoint so a restarted replica boots on current weights
         self.checkpoint = checkpoint
@@ -239,6 +251,8 @@ class ReplicaProcess:
             cmd.extend(["--checkpoint", os.fspath(self.checkpoint)])
         if self.precision:
             cmd.extend(["--precision", self.precision])
+        if self.trace:
+            cmd.append("--trace")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = (_repo_root() + os.pathsep

@@ -61,7 +61,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence
 from urllib.parse import urlparse
 
-from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.monitor import get_registry, trace
+from deeplearning4j_tpu.monitor import tracing
+from deeplearning4j_tpu.monitor.slo import BurnRateSLO
 from deeplearning4j_tpu.serving.client import InferenceClient
 
 __all__ = ["Router", "RetryBudget", "ReplicaState"]
@@ -212,6 +214,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         json.dumps(info).encode())
         elif path == "/stats":
             self._reply(200, json.dumps(router.stats()).encode())
+        elif path == "/trace":
+            # the router process's span ring buffer; merged with every
+            # replica's by monitor/collect.collect_fleet_trace
+            self._reply(200, json.dumps(trace.export()).encode())
         elif path == "/metrics":
             data = get_registry().render().encode()
             self.send_response(200)
@@ -364,6 +370,24 @@ class Router:
             "dl4jtpu_router_replica_outstanding",
             "In-flight upstream requests per replica (the "
             "least-outstanding balancing signal).", ("router", "replica"))
+        # availability SLO over routed /predict + /generate: only the
+        # ``error`` outcome (every replica failed / budget spent / router
+        # deadline) burns budget — sheds are policy, failovers and hedge
+        # wins answered the client fine. Shares the router's injectable
+        # clock so fake-clock tests drive the burn windows directly.
+        sli, bad = [], []
+        for p in ("/predict", "/generate"):
+            for oc in ("ok", "failed_over", "hedge_win", "shed", "error"):
+                child = self._m_requests.labels(router=self.id, path=p,
+                                                outcome=oc)
+                sli.append(child)
+                if oc == "error":
+                    bad.append(child)
+        self.slo = BurnRateSLO(
+            f"router_availability:{self.id}",
+            bad_fn=lambda: sum(c.value for c in bad),
+            total_fn=lambda: sum(c.value for c in sli),
+            objective=0.99, clock=clock)
         for url in upstreams:
             self._add_replica(url)
 
@@ -584,10 +608,17 @@ class Router:
         shed = self._admit(tenant, priority, rid)
         if shed is not None:
             return shed
+        # the fleet trace root: trace_id = the router-minted request id.
+        # Every span below (route here, attempt per upstream try, and —
+        # via the x-trace-context header — the winning replica's whole
+        # handler/engine chain) carries this id.
+        ctx = tracing.TraceContext(rid)
         try:
-            expires = self._expiry(body)
-            hedge = self.hedge_enabled and path == "/predict"
-            return self._forward(path, body, rid, expires, hedge)
+            with tracing.trace_context(ctx), \
+                    trace.span("route", path=path):
+                expires = self._expiry(body)
+                hedge = self.hedge_enabled and path == "/predict"
+                return self._forward(path, body, rid, expires, hedge)
         finally:
             self._release(tenant)
 
@@ -605,17 +636,26 @@ class Router:
 
     # ------------------------------------------------------------ forwarding
     def _run_attempt(self, att: _Attempt, path: str, body: bytes,
-                     results: "queue.Queue") -> None:
+                     results: "queue.Queue",
+                     ctx: Optional[tracing.TraceContext] = None) -> None:
         rep = att.replica
         with rep.lock:
             rep.outstanding += 1
         self._m_attempts.labels(router=self.id, replica=rep.url).inc()
+        # the attempt id (rid#aN) becomes the replica-side parent span id,
+        # riding the x-trace-context header next to x-request-id
+        actx = ctx.child(att.rid) if ctx is not None else None
+        req_headers = {"x-request-id": att.rid}
+        if actx is not None:
+            req_headers["x-trace-context"] = actx.to_header()
         t0 = time.perf_counter()
         try:
-            att.conn = rep.client._conn()
-            status, data, hdrs = rep.client.post_raw(
-                path, body, headers={"x-request-id": att.rid},
-                give_up=att.cancelled.is_set)
+            with tracing.trace_context(actx), \
+                    trace.span("attempt", rid=att.rid, replica=rep.url):
+                att.conn = rep.client._conn()
+                status, data, hdrs = rep.client.post_raw(
+                    path, body, headers=req_headers,
+                    give_up=att.cancelled.is_set)
             results.put((att, status, data, hdrs, None,
                          time.perf_counter() - t0))
         except Exception as e:  # noqa: BLE001 — classified by the waiter
@@ -643,11 +683,14 @@ class Router:
         tried = set()
         n_attempt = itertools.count()
 
+        ctx = tracing.get_context()
+
         def launch(rep: _Replica) -> None:
             att = _Attempt(rep, f"{rid}#a{next(n_attempt)}")
             tried.add(rep.url)
             live.append(att)
-            self._pool.submit(self._run_attempt, att, path, body, results)
+            self._pool.submit(self._run_attempt, att, path, body, results,
+                              ctx)
 
         def outcome(tag: str):
             self._m_requests.labels(router=self.id, path=path,
@@ -814,6 +857,13 @@ class Router:
             return {"status": "degraded", "reason": "no_routable_replicas"}
         if routable < len(states):
             return {"status": "degraded", "reason": "replicas_out"}
+        try:
+            slo = self.slo.evaluate()
+        except Exception:       # noqa: BLE001 — SLO math can't break health
+            slo = None
+        if slo is not None and slo.fast_burn:
+            return {"status": "degraded", "reason": "slo_fast_burn",
+                    "slo": slo.as_dict()}
         return {"status": "ok"}
 
     def stats(self) -> dict:

@@ -9,9 +9,12 @@ Endpoints:
   POST /predict  {"ndarray": {shape, data}, "deadline_ms"?} → {"ndarray": ...}
   POST /warmup   {"input_shape": [...], "max_batch"}        → {"buckets": [...]}
   POST /admin/swap {"checkpoint": path, "version"?}         → {"version": n}
+  POST /admin/profile {"dir": d, "seconds"?}                → timed jax.profiler capture
   GET  /stats                                               → engine+batcher stats
   GET  /metrics                                             → Prometheus text
   GET  /healthz                                             → {"status": ...}
+  GET  /trace                                               → span ring buffer (Chrome JSON)
+  GET  /programs                                            → compiled-program cost table
 
 /predict and /generate responses carry ``x-model-version`` (the serving
 weights' hot-swap version, docs/ONLINE_LEARNING.md); 409 with type
@@ -31,6 +34,7 @@ load balancers pull the instance while in-flight work flushes).
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import threading
@@ -44,6 +48,8 @@ import numpy as np
 from deeplearning4j_tpu.clustering.knn_server import (
     ndarray_from_b64, ndarray_to_b64)
 from deeplearning4j_tpu.monitor import get_registry, trace
+from deeplearning4j_tpu.monitor import profiling, tracing
+from deeplearning4j_tpu.monitor.slo import BurnRateSLO
 from deeplearning4j_tpu.resilience.errors import (
     BatcherStoppedError, CorruptCheckpointError, DeadlineExceededError,
     InjectedFaultError, ServerOverloadedError, WeightSwapError)
@@ -51,7 +57,8 @@ from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 
 _KNOWN_PATHS = ("/predict", "/generate", "/warmup", "/stats", "/metrics",
-                "/healthz", "/chaos", "/admin/swap")
+                "/healthz", "/chaos", "/admin/swap", "/trace", "/programs",
+                "/admin/profile")
 
 
 def _http_metrics():
@@ -87,6 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _json(self, obj, code=200, extra_headers=None):
         data = json.dumps(obj).encode()
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
@@ -105,6 +113,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _text(self, body: str, content_type: str, code=200):
         data = body.encode()
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
@@ -118,14 +127,23 @@ class _Handler(BaseHTTPRequestHandler):
         # so a URL-probing client can't mint unbounded label values
         counter, hist = _http_metrics()
         label = path if path in _KNOWN_PATHS else "other"
+        # router-minted trace context: installed thread-local for the whole
+        # handler, so this request's spans (http_request and, via the
+        # batcher's queue item, the engine's bucket/pad/device/readback)
+        # all carry the fleet trace_id
+        ctx = tracing.TraceContext.from_header(
+            self.headers.get("x-trace-context"))
+        self._status = 200
         t0 = time.perf_counter()
         try:
-            with trace.span("http_request", path=label,
-                            request_id=self._rid or ""):
-                fn()
+            with tracing.trace_context(ctx):
+                with trace.span("http_request", path=label,
+                                request_id=self._rid or ""):
+                    fn()
         finally:
             counter.labels(path=label).inc()
             hist.labels(path=label).observe(time.perf_counter() - t0)
+            self.server.inference.note_response(label, self._status)
 
     def do_GET(self):
         srv = self.server.inference
@@ -141,6 +159,13 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/metrics":
                 self._text(get_registry().render(),
                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/trace":
+                # this process's span ring buffer as one Chrome trace-event
+                # document — what monitor/collect.py pulls per process
+                self._json(trace.export())
+            elif path == "/programs":
+                from deeplearning4j_tpu.exec.programs import get_programs
+                self._json({"programs": get_programs().entries()})
             else:
                 self._error(404, "not_found", f"no such path: {path}")
 
@@ -179,6 +204,8 @@ class _Handler(BaseHTTPRequestHandler):
                         self._json({"chaos": srv.fault_injector.describe()})
                 elif path == "/admin/swap":
                     self._admin_swap(srv, payload)
+                elif path == "/admin/profile":
+                    self._admin_profile(srv, payload)
                 elif path == "/warmup":
                     try:
                         shape = payload["input_shape"]
@@ -235,6 +262,25 @@ class _Handler(BaseHTTPRequestHandler):
         self._json({"swapped": True, "version": v,
                     "checkpoint": str(ck),
                     "compiled_programs": srv.engine.trace_count})
+
+    def _admin_profile(self, srv, payload):
+        """POST /admin/profile {"dir": path, "seconds"?: float} — wrap the
+        next N seconds of live traffic in ``jax.profiler.trace``; one
+        session at a time per process (409 while one runs)."""
+        if profiling.profile_status()["profiling"]:
+            self._error(409, "profile_busy",
+                        "a profiling session is already running")
+            return
+        try:
+            out = profiling.start_profile(
+                payload.get("dir", ""),
+                seconds=float(payload.get("seconds", 5.0)))
+        except (TypeError, ValueError) as e:
+            raise BadRequestError(str(e)) from None
+        except RuntimeError as e:
+            self._error(503, "profiler_unavailable", str(e))
+            return
+        self._json(out)
 
     def _predict(self, srv, payload):
         try:
@@ -313,6 +359,8 @@ class InferenceServer:
     does not send ``deadline_ms`` (None = no deadline).
     """
 
+    _ids = itertools.count()
+
     def __init__(self, model, port: int = 9300, host: str = "127.0.0.1",
                  max_batch: int = 256, max_latency_ms: float = 2.0,
                  engine: Optional[InferenceEngine] = None,
@@ -348,11 +396,48 @@ class InferenceServer:
         self._m_engine_errors = get_registry().counter(
             "dl4jtpu_serving_engine_errors_total",
             "Engine faults surfaced as HTTP 500 by the inference server.")
+        # per-instance response classes: the SLI under the burn-rate SLO.
+        # Labelled by server instance so a restarted replica starts with a
+        # clean error budget instead of inheriting the old process-lifetime
+        # counters (the registry is process-wide).
+        self.id = f"server{next(InferenceServer._ids)}"
+        self._m_responses = get_registry().counter(
+            "dl4jtpu_http_responses_total",
+            "HTTP responses by status class, per server instance.",
+            ("server", "path", "class"))
+        sli, bad = [], []
+        for p in ("/predict", "/generate"):
+            for c in ("2xx", "4xx", "5xx"):
+                child = self._m_responses.labels(
+                    server=self.id, path=p, **{"class": c})
+                sli.append(child)
+                if c == "5xx":
+                    bad.append(child)
+        # availability SLO over /predict + /generate: 5xx (engine faults,
+        # injected chaos) burn the budget; 4xx are the client's problem.
+        # Fast burn at 14.4x ≈ a sustained >14% 5xx rate over BOTH the 5m
+        # and 1h windows — /healthz flips to degraded, and recovers as
+        # soon as the short window clears (docs/OBSERVABILITY.md).
+        self.slo = BurnRateSLO(
+            f"availability:{self.id}",
+            bad_fn=lambda: sum(c.value for c in bad),
+            total_fn=lambda: sum(c.value for c in sli),
+            objective=0.99)
 
     # --------------------------------------------------------------- health
     def note_engine_error(self, e: BaseException) -> None:
         self.last_error = f"{type(e).__name__}: {e}"
         self._m_engine_errors.inc()
+
+    def note_response(self, path: str, code: int) -> None:
+        """Count one HTTP response by status class (called by the handler
+        for every request; feeds the availability SLO)."""
+        try:
+            cls = f"{int(code) // 100}xx"
+            self._m_responses.labels(server=self.id, path=path,
+                                     **{"class": cls}).inc()
+        except Exception:   # noqa: BLE001 — accounting never breaks serving
+            pass
 
     def validate_features(self, x: np.ndarray) -> None:
         """400 for wrong rank / feature width when the model's conf declares
@@ -389,6 +474,13 @@ class InferenceServer:
                 extra = None    # the whole server unhealthy
             if extra and extra.get("status") not in (None, "ok"):
                 return extra
+        try:
+            slo = self.slo.evaluate()
+        except Exception:       # noqa: BLE001 — SLO math can't break health
+            slo = None
+        if slo is not None and slo.fast_burn:
+            return {"status": "degraded", "reason": "slo_fast_burn",
+                    "slo": slo.as_dict()}
         return {"status": "ok"}
 
     def health(self) -> str:

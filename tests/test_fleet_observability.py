@@ -1,0 +1,442 @@
+"""Fleet-wide observability (monitor/tracing + collect + slo, exec/programs).
+
+The load-bearing claims pinned here:
+- a TraceContext minted at the router rides the ``x-trace-context``
+  header into subprocess replicas, and ``collect_fleet_trace`` merges
+  the router's and every replica's ring buffer into ONE Perfetto doc
+  with spans from >=4 processes reachable from one router trace_id —
+  including both attempts of a hedged request and the winner's device
+  spans;
+- ``Tracer.export`` drops orphan ``E`` events after a ring wrap (an
+  unbalanced ``E`` makes Perfetto mis-nest the whole track) while a
+  still-open ``B`` is kept;
+- argless spans are cached per name and a trace context never leaks
+  into the cached args;
+- compiled programs land in the XLA program registry with cost/memory
+  analysis, served at ``GET /programs`` and exported as
+  ``dl4jtpu_program_*`` gauges — without double-counting the callers'
+  compile accounting (``_compile_count`` stays 1);
+- the burn-rate SLO degrades ``/healthz`` only when BOTH windows burn
+  fast, and recovers as soon as the short window clears (fake clock);
+- ``POST /admin/profile`` wraps live traffic in a timed jax.profiler
+  capture (one session at a time: 409 while running, 400 for junk);
+- the metric catalog in docs/OBSERVABILITY.md matches the code exactly
+  (tools/lint_metrics.py gates tier-1 through this file).
+"""
+
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.monitor import get_registry, trace
+from deeplearning4j_tpu.monitor.collect import collect_fleet_trace, merge_docs
+from deeplearning4j_tpu.monitor.slo import BurnRateSLO
+from deeplearning4j_tpu.monitor.tracing import (TraceContext, Tracer,
+                                                trace_context)
+from deeplearning4j_tpu.exec.programs import get_programs
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.serving import (InferenceClient, InProcessReplica,
+                                        ReplicaProcess, Router)
+
+X = np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+# ----------------------------------------------------------- trace context
+
+def test_trace_context_header_roundtrip():
+    ctx = TraceContext("req-42")
+    assert ctx.to_header() == "req-42"
+    child = ctx.child("req-42#a1")
+    assert child.trace_id == "req-42" and child.parent == "req-42#a1"
+    back = TraceContext.from_header(child.to_header())
+    assert back.trace_id == "req-42" and back.parent == "req-42#a1"
+    assert TraceContext.from_header(None) is None
+    assert TraceContext.from_header("") is None
+    assert TraceContext.from_header("   ") is None
+    # header without a parent half
+    solo = TraceContext.from_header("req-7")
+    assert solo.trace_id == "req-7" and solo.parent == ""
+
+
+def test_span_records_context_and_wall_clock_timestamps():
+    tr = Tracer(capacity=64, enabled=True)
+    with trace_context(TraceContext("req-1", "req-1#a0")):
+        with tr.span("work", n=3):
+            pass
+    b = tr.events()[0]
+    assert b["args"]["trace_id"] == "req-1"
+    assert b["args"]["parent"] == "req-1#a0"
+    assert b["args"]["n"] == 3
+    # timestamps are unix-epoch microseconds (mergeable across processes)
+    assert abs(b["ts"] / 1e6 - time.time()) < 5.0
+
+
+def test_argless_span_cached_and_context_never_leaks():
+    tr = Tracer(capacity=64, enabled=True)
+    s1 = tr.span("hot")
+    s2 = tr.span("hot")
+    assert s1 is s2                     # one allocation per name, ever
+    with trace_context(TraceContext("req-9")):
+        with s1:
+            pass
+    with s1:                            # same cached span, no context now
+        pass
+    evs = [e for e in tr.events() if e["ph"] == "B"]
+    assert evs[0]["args"] == {"trace_id": "req-9"}
+    assert "args" not in evs[1]         # the context did not stick
+
+
+def test_export_drops_orphan_end_events_after_ring_wrap():
+    tr = Tracer(capacity=6, enabled=True)
+    with tr.span("outer"):
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+    # ring kept: E_s7, B_s8, E_s8, B_s9, E_s9, E_outer — the two E events
+    # whose B fell off the ring must not survive export
+    kept = [e for e in tr.export()["traceEvents"] if e["ph"] != "M"]
+    assert [(e["ph"], e["name"]) for e in kept] == [
+        ("B", "s8"), ("E", "s8"), ("B", "s9"), ("E", "s9")]
+
+
+def test_export_keeps_unmatched_begin_of_open_span():
+    tr = Tracer(capacity=16, enabled=True)
+    span = tr.span("still-open")
+    span.__enter__()                    # never exited: span is in flight
+    kept = [e for e in tr.export()["traceEvents"] if e["ph"] != "M"]
+    assert [(e["ph"], e["name"]) for e in kept] == [("B", "still-open")]
+
+
+def test_merge_docs_dedups_metadata_and_rebases():
+    a = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+         "args": {"name": "router"}},
+        {"ph": "B", "name": "route", "pid": 1, "tid": 1, "ts": 2000.0},
+        {"ph": "E", "name": "route", "pid": 1, "tid": 1, "ts": 3000.0}]}
+    b = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+         "args": {"name": "router"}},          # duplicate: dropped
+        {"ph": "B", "name": "device", "pid": 2, "tid": 1, "ts": 2500.0},
+        {"ph": "E", "name": "device", "pid": 2, "tid": 1, "ts": 2600.0}]}
+    doc = merge_docs([a, b])
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert len(meta) == 1
+    assert min(e["ts"] for e in evs) == 0.0    # rebased to t=0
+    assert [e["name"] for e in evs] == ["route", "device", "device", "route"]
+
+
+# ------------------------------------------------------- program registry
+
+def _mln(seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_fit_scan_registers_program_without_double_counting_compiles():
+    net = _mln()
+    rs = np.random.RandomState(0)
+    k, b = 2, 128
+    xs = rs.randn(k, b, 6).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (k, b))]
+    net.fit_scan(xs, ys)
+    rec = get_programs().get(net._prog_caller, f"fit_scan_k{k}_b{b}")
+    assert rec is not None
+    assert rec["flops"] and rec["flops"] > 0
+    assert rec["memory_bytes"] and rec["memory_bytes"] > 0
+    assert rec["compile_seconds"] and rec["compile_seconds"] > 0
+    # the registration relower re-traces the scan body; the container's
+    # compile accounting must not see it twice
+    assert net._compile_count == 1
+    net.fit_scan(xs, ys)                # warm call: still one program
+    assert net._compile_count == 1
+    # the registry exports per-program gauges
+    text = get_registry().render()
+    assert f'dl4jtpu_program_flops{{caller="{net._prog_caller}"' in text
+
+
+@pytest.fixture(scope="module")
+def mlp_replica():
+    rep = InProcessReplica(model="mlp").start()
+    yield rep
+    rep.stop()
+
+
+def test_engine_programs_served_over_http(mlp_replica):
+    cli = InferenceClient(mlp_replica.url)
+    try:
+        cli.predict(X)                  # compiles (or reuses) one bucket
+    finally:
+        cli.close()
+    engine_id = mlp_replica.srv.engine.id
+    mine = [p for p in get_programs().entries()
+            if p["caller"] == engine_id]
+    assert mine, "engine compile did not register any program"
+    assert any(p["key"].startswith("b") for p in mine)
+    st, body = _get_json(f"{mlp_replica.url}/programs")
+    assert st == 200
+    served = [p for p in body["programs"] if p["caller"] == engine_id]
+    assert {p["key"] for p in served} == {p["key"] for p in mine}
+    assert all(set(p) >= {"caller", "key", "flops", "bytes",
+                          "memory_bytes", "compile_seconds"}
+               for p in served)
+
+
+# ------------------------------------------------------------- SLO engine
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_burn_rate_slo_state_machine_under_fake_clock():
+    clk = _Clock()
+    counts = {"bad": 0.0, "total": 0.0}
+    slo = BurnRateSLO("t", lambda: counts["bad"], lambda: counts["total"],
+                      objective=0.99, short_s=300.0, long_s=3600.0,
+                      min_events=20, clock=clk, min_tick_s=0.0)
+    st = slo.evaluate()                           # idle process
+    assert not st.fast_burn and st.budget_remaining == 1.0
+
+    # min_events guard: 5 failures in an idle process must not page
+    counts["bad"] += 5
+    counts["total"] += 5
+    clk.t = 30.0
+    st = slo.evaluate()
+    assert st.burn_short == 0.0 and not st.fast_burn
+
+    # slow burn: ~5% errors against a 1% budget is visible but not fast
+    counts["total"] += 100
+    clk.t = 60.0
+    st = slo.evaluate()
+    assert 0.0 < st.burn_short < slo.fast_threshold
+    assert not st.fast_burn
+
+    # storm: error rate >> budget in BOTH windows -> degraded
+    counts["bad"] += 80
+    counts["total"] += 100
+    clk.t = 120.0
+    st = slo.evaluate()
+    assert st.burn_short > slo.fast_threshold
+    assert st.burn_long > slo.fast_threshold
+    assert st.fast_burn
+    assert st.budget_remaining == 0.0
+
+    # recovery: healthy traffic clears the 5m window while the 1h window
+    # is still digesting the storm — the AND rule re-admits immediately
+    counts["total"] += 30
+    clk.t = 200.0
+    slo.tick()
+    counts["total"] += 30
+    clk.t = 380.0
+    slo.tick()
+    clk.t = 430.0
+    st = slo.evaluate()
+    assert st.burn_short == 0.0
+    assert st.burn_long > slo.fast_threshold      # long window still hot
+    assert not st.fast_burn
+    d = st.as_dict()
+    assert d["fast_burn"] is False and d["name"] == "t"
+    # the state is exported as gauges
+    text = get_registry().render()
+    assert 'dl4jtpu_slo_burn_rate{slo="t",window="short"}' in text
+    assert 'dl4jtpu_slo_budget_remaining{slo="t"}' in text
+
+
+def test_healthz_degrades_on_fast_burn_and_recovers(mlp_replica):
+    srv = mlp_replica.srv
+    st, body = _get_json(f"{mlp_replica.url}/healthz")
+    assert st == 200 and body == {"status": "ok"}
+
+    clk = _Clock()
+    counts = {"bad": 0.0, "total": 0.0}
+    orig = srv.slo
+    srv.slo = BurnRateSLO(f"availability:{srv.id}",
+                          lambda: counts["bad"], lambda: counts["total"],
+                          objective=0.99, clock=clk, min_tick_s=0.0)
+    try:
+        srv.slo.evaluate()                        # baseline snapshot at t=0
+        counts["bad"] += 60
+        counts["total"] += 100
+        clk.t = 60.0
+        st, body = _get_json(f"{mlp_replica.url}/healthz")
+        assert st == 200                          # degraded, not draining
+        assert body["status"] == "degraded"
+        assert body["reason"] == "slo_fast_burn"
+        assert body["slo"]["fast_burn"] is True
+        assert body["slo"]["name"] == f"availability:{srv.id}"
+        # short window clears -> healthy again, byte-identical body
+        counts["total"] += 40
+        clk.t = 200.0
+        srv.slo.tick()
+        clk.t = 430.0
+        st, body = _get_json(f"{mlp_replica.url}/healthz")
+        assert st == 200 and body == {"status": "ok"}
+    finally:
+        srv.slo = orig
+
+
+# ------------------------------------------------------ on-demand profiling
+
+def test_admin_profile_wraps_live_traffic(mlp_replica, tmp_path):
+    from deeplearning4j_tpu.monitor import profiling
+
+    def post(payload):
+        c = InferenceClient(mlp_replica.url, retries=1)
+        try:
+            return c.post_raw("/admin/profile", json.dumps(payload).encode())
+        finally:
+            c.close()
+
+    # junk is rejected before any profiler state is touched
+    st, body, _ = post({})                        # no dir
+    assert st == 400, body
+    st, body, _ = post({"dir": str(tmp_path / "p"), "seconds": -1})
+    assert st == 400, body
+
+    out = str(tmp_path / "capture")
+    st, body, _ = post({"dir": out, "seconds": 0.4})
+    assert st == 200, body
+    assert json.loads(body)["profiling"] == out
+    # one session at a time per process
+    st, body, _ = post({"dir": out, "seconds": 0.4})
+    assert st == 409
+    assert json.loads(body)["error"]["type"] == "profile_busy"
+    # live traffic lands inside the capture window
+    cli = InferenceClient(mlp_replica.url)
+    try:
+        cli.predict(X)
+    finally:
+        cli.close()
+    deadline = time.monotonic() + 15.0
+    while profiling.profile_status()["profiling"]:
+        assert time.monotonic() < deadline, "profile session never stopped"
+        time.sleep(0.05)
+    captured = [os.path.join(r, f)
+                for r, _, fs in os.walk(out) for f in fs]
+    assert captured, "jax.profiler wrote nothing"
+
+
+# ----------------------------------------------------------- metric catalog
+
+def test_metric_catalog_matches_code():
+    """tools/lint_metrics.py gates tier-1 from here: every dl4jtpu_*
+    literal in the package has a docs/OBSERVABILITY.md catalog row and
+    vice versa."""
+    path = Path(__file__).resolve().parent.parent / "tools" / "lint_metrics.py"
+    spec = importlib.util.spec_from_file_location("lint_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    problems = mod.lint()
+    assert problems == [], "\n".join(problems)
+    assert len(mod.code_metrics()) > 50           # the scan actually scanned
+
+
+# ------------------------------------------------------- fleet trace merge
+
+def test_fleet_trace_merges_router_and_replica_spans(tmp_path):
+    """3 subprocess replicas + the in-process router under a hedged storm:
+    the collected doc has spans from >=4 processes, and one router-minted
+    trace_id reaches hedged attempt spans AND the winning replica's
+    device spans (the ISSUE's fleet-trace acceptance bar)."""
+    reps = [ReplicaProcess(str(tmp_path), model="mlp", trace=True,
+                           name=f"replica{i}").start()
+            for i in range(3)]
+    router = None
+    cli = None
+    try:
+        for r in reps:
+            r.wait_ready()
+        trace.enable(True)
+        trace.clear()
+        trace.set_process_name("router")
+        router = Router([r.url for r in reps], port=0, probe_interval=None,
+                        hedge=True, hedge_delay_ms=40.0,
+                        upstream_timeout=60.0).start()
+        base = f"http://127.0.0.1:{router.port}"
+        cli = InferenceClient(base, timeout=60.0)
+
+        # one slow replica: round-robin lands ~1/3 of primaries on it, the
+        # 40 ms hedge fires and the fast copy wins
+        c = InferenceClient(reps[0].url, retries=1)
+        try:
+            st, body, _ = c.post_raw(
+                "/chaos", json.dumps({"latency_ms": 1500.0}).encode())
+            assert st == 200, body
+        finally:
+            c.close()
+        for _ in range(9):
+            cli.predict(X)
+        time.sleep(0.3)                 # let in-flight E events land
+
+        doc = collect_fleet_trace(base, path=str(tmp_path / "fleet.json"))
+        assert len(doc["collectedFrom"]) == 4     # router + 3 replicas
+        evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+        pids = {e["pid"] for e in evs}
+        assert len(pids) >= 4                     # spans from >=4 processes
+
+        # every process announces a swimlane name
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        assert "router" in names
+        assert sum(1 for n in names if n.startswith("replica:mlp@")) == 3
+
+        router_pid = os.getpid()
+        attempts = {}                             # trace_id -> {rid, ...}
+        for e in evs:
+            if e.get("name") == "attempt" and e["ph"] == "B":
+                a = e.get("args", {})
+                if "trace_id" in a and "rid" in a:
+                    attempts.setdefault(a["trace_id"], set()).add(a["rid"])
+        assert attempts, "router recorded no attempt spans"
+        hedged = {tid: rids for tid, rids in attempts.items()
+                  if len(rids) >= 2}
+        assert hedged, "no request was hedged — both attempt spans missing"
+        tid, rids = next(iter(sorted(hedged.items())))
+        assert any(r.endswith("#a0") for r in rids)
+        assert any(r.endswith("#a1") for r in rids)
+
+        # the winner's whole replica-side chain carries the same trace_id
+        replica_spans = [e for e in evs
+                         if e["pid"] != router_pid
+                         and e.get("args", {}).get("trace_id") == tid]
+        assert replica_spans, f"trace {tid} never reached a replica"
+        replica_names = {e["name"] for e in replica_spans}
+        assert "http_request" in replica_names
+        assert "device" in replica_names          # engine spans joined in
+
+        # the exported file is a loadable Chrome trace-event doc
+        with open(tmp_path / "fleet.json") as f:
+            on_disk = json.load(f)
+        assert on_disk["traceEvents"]
+    finally:
+        trace.enable(False)
+        trace.clear()
+        trace.set_process_name("")
+        if cli is not None:
+            cli.close()
+        if router is not None:
+            router.stop()
+        for r in reps:
+            r.stop()

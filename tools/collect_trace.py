@@ -1,0 +1,64 @@
+"""Collect one fleet-wide Perfetto trace from a running serving tier.
+
+Pulls ``GET /trace`` from the router and from every replica it knows
+about (discovered via the router's ``/stats``), merges the ring buffers
+onto one timeline — every tracer stamps absolute wall-clock microseconds,
+so spans from different processes line up without clock negotiation —
+and writes a single Chrome trace-event JSON that chrome://tracing or
+https://ui.perfetto.dev opens directly. Process-name metadata rides
+along, so the router and each ``replica:<model>@<port>`` get labelled
+swimlanes, and the ``trace_id`` minted at the router appears in the args
+of every span a request touched on its way through the tier.
+
+    python tools/collect_trace.py http://127.0.0.1:9300 -o fleet.json
+
+Replicas must be running with tracing on (``--trace`` on
+serving/replica.py, or ``trace.enable(True)`` in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    from deeplearning4j_tpu.monitor.collect import collect_fleet_trace
+
+    ap = argparse.ArgumentParser(
+        description="Merge router + replica trace ring buffers into one "
+                    "Perfetto document.")
+    ap.add_argument("router", help="router base URL, e.g. "
+                                   "http://127.0.0.1:9300")
+    ap.add_argument("-o", "--out", default="fleet_trace.json",
+                    help="output path (default: fleet_trace.json)")
+    ap.add_argument("--extra", nargs="*", default=(), metavar="URL",
+                    help="additional /trace endpoints not in the router's "
+                         "replica set")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="per-endpoint fetch timeout in seconds")
+    ap.add_argument("--no-rebase", action="store_true",
+                    help="keep absolute unix-epoch timestamps instead of "
+                         "rebasing the merged doc to t=0")
+    args = ap.parse_args(argv)
+
+    doc = collect_fleet_trace(args.router, extra_urls=args.extra,
+                              path=args.out, timeout=args.timeout,
+                              rebase=not args.no_rebase)
+    events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    pids = {e["pid"] for e in events if "pid" in e}
+    print(f"wrote {args.out}: {len(events)} events from "
+          f"{len(pids)} process(es) across "
+          f"{len(doc.get('collectedFrom', []))} endpoint(s)")
+    if not events:
+        print("no spans collected — is tracing enabled on the tier "
+              "(replica --trace / DL4JTPU_TRACE)?", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

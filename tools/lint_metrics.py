@@ -1,0 +1,78 @@
+"""Metric-catalog lint: code and docs/OBSERVABILITY.md must agree.
+
+Every ``dl4jtpu_*`` metric name that appears as a string literal in the
+package must have a catalog row in docs/OBSERVABILITY.md, and every name
+the catalog documents must still exist in code — both directions, full
+names only (a catalog row may not abbreviate ``..._spent_total /
+_denied_total``; each series gets its own complete name so a reader can
+grep the doc for exactly what a scrape shows).
+
+Run standalone (exit 1 on drift, one problem per line), or through
+``tests/test_fleet_observability.py`` where it gates tier-1:
+
+    python tools/lint_metrics.py
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "deeplearning4j_tpu"
+CATALOG = ROOT / "docs" / "OBSERVABILITY.md"
+
+# a metric name is only counted where it is a quoted/backticked literal —
+# prose mentions and grep examples with bare prefixes don't register
+_NAME = re.compile(r"""["'`](dl4jtpu_[a-z0-9_]+)["'`]""")
+
+
+def code_metrics() -> set:
+    """Every dl4jtpu_* string literal in the package source."""
+    names = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        for m in _NAME.finditer(path.read_text(encoding="utf-8")):
+            names.add(m.group(1))
+    return names
+
+
+def doc_metrics() -> set:
+    """Every dl4jtpu_* name in a catalog table row (lines starting with
+    ``|``) of docs/OBSERVABILITY.md. Prose and shell examples outside the
+    tables are free to use loose prefixes."""
+    names = set()
+    for line in CATALOG.read_text(encoding="utf-8").splitlines():
+        if line.lstrip().startswith("|"):
+            for m in _NAME.finditer(line):
+                names.add(m.group(1))
+    return names
+
+
+def lint() -> list:
+    """Problems as printable strings; empty means the catalog is exact."""
+    code, doc = code_metrics(), doc_metrics()
+    problems = []
+    for name in sorted(code - doc):
+        problems.append(
+            f"undocumented metric: {name} exists in code but has no "
+            f"catalog row in {CATALOG.relative_to(ROOT)}")
+    for name in sorted(doc - code):
+        problems.append(
+            f"stale catalog row: {name} is documented in "
+            f"{CATALOG.relative_to(ROOT)} but no longer exists in code")
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    n_code, n_doc = len(code_metrics()), len(doc_metrics())
+    print(f"checked {n_code} metrics in code against {n_doc} catalog rows: "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
