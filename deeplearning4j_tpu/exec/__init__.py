@@ -16,6 +16,8 @@ from deeplearning4j_tpu.exec.executor import (Executor,  # noqa: F401
                                               PARAMS, STATE, OPT, REPL,
                                               BATCH, STEP_BATCH, SLOTS)
 from deeplearning4j_tpu.exec.routing import (lstm_fwd_route,  # noqa: F401
+                                             lstm_grad_route,
+                                             flash_attn_route,
                                              decode_attn_route,
                                              set_route, load_measurements,
                                              load_measurements_file)
@@ -27,7 +29,8 @@ __all__ = [
     "set_default_mesh", "host_device_env",
     "Executor", "get_executor", "set_executor", "param_spec",
     "PARAMS", "STATE", "OPT", "REPL", "BATCH", "STEP_BATCH", "SLOTS",
-    "lstm_fwd_route", "decode_attn_route", "set_route",
+    "lstm_fwd_route", "lstm_grad_route", "flash_attn_route",
+    "decode_attn_route", "set_route",
     "load_measurements", "load_measurements_file",
     "ProgramRegistry", "get_programs", "is_registering",
 ]

@@ -93,7 +93,8 @@ class Executor:
     def __init__(self, mesh: Optional[Mesh] = None, *,
                  min_rows_per_shard: Optional[int] = None,
                  min_slots_per_shard: Optional[int] = None,
-                 precision: Optional[str] = None):
+                 precision: Optional[str] = None,
+                 train_precision: Optional[str] = None):
         self.mesh = default_mesh() if mesh is None else mesh
         self.data_size = (self.mesh.shape[DATA_AXIS]
                           if DATA_AXIS in self.mesh.axis_names else 1)
@@ -112,6 +113,33 @@ class Executor:
         self.precision = resolve_precision(
             precision if precision is not None
             else os.environ.get("DL4JTPU_PRECISION"))
+        # declarative TRAINING precision: 'bf16' casts activations+params
+        # to bfloat16 in the fit-path forward of every f32 model built
+        # against this executor (loss and updater math stay f32 — the MXU
+        # accumulates bf16 matmuls in f32, docs/TRAINING_PERF.md). Read at
+        # trace time: containers rebuilt against a new executor pick it up.
+        tp = (train_precision if train_precision is not None
+              else os.environ.get("DL4JTPU_TRAIN_PRECISION")) or "f32"
+        tp = tp.strip().lower()
+        if tp not in ("f32", "float32", "bf16", "bfloat16"):
+            raise ValueError(
+                f"train_precision must be 'f32' or 'bf16', got {tp!r}")
+        self.train_precision = "bf16" if tp in ("bf16", "bfloat16") else "f32"
+        try:
+            from deeplearning4j_tpu.monitor.metrics import get_registry
+            get_registry().gauge(
+                "dl4jtpu_train_precision_bf16",
+                "1 when the executor's training-precision policy is bf16"
+            ).set(1.0 if self.train_precision == "bf16" else 0.0)
+        except Exception:
+            pass
+
+    @property
+    def train_dtype(self):
+        """The compute dtype the train-precision policy imposes on the fit
+        path (None = storage dtype, i.e. no cast)."""
+        import jax.numpy as jnp
+        return jnp.bfloat16 if self.train_precision == "bf16" else None
 
     def prepare_params(self, tree, precision: Optional[str] = None):
         """Apply the serving-precision policy to a weight tree: per-channel

@@ -13,22 +13,31 @@ heuristic routed to Pallas anyway:
 This module owns the routing decision per (backend, kernel, phase,
 shape). The shipped measurement file (KERNELS_TPU.json at the repo
 root) is absorbed wholesale at first use — every row with a measured
-``fwd_speedup`` routes to pallas iff it beat XLA, for f32 and bf16
-alike — and the measured heuristic covers everything in between. The
-backward kernel wins at every validated shape, so only the forward
-routes.
+``fwd_speedup`` routes the forward to pallas iff it beat XLA, and every
+row with a measured ``grad_route``/``grad_speedup`` routes the BACKWARD
+the same way (the backward kernel wins at most validated shapes, but
+two measured bf16 rows lose — (4,16,8) 0.24x, (8,32,120) 0.4x — so the
+backward is measurement-routed exactly like the forward, with pallas as
+the no-data default). Tables produced by the per-backend autotune
+harness (exec/autotune.py — persisted next to the compile cache) merge
+on top of the shipped file, so first-use measurements on the actual
+backend override v5e numbers.
 
 Overrides, strongest first:
 
 1. ``set_route(kernel, "pallas"|"scan"|None)`` — programmatic pin
-   (per kernel: "fused_lstm", "decode_attn")
-2. ``DL4JTPU_LSTM_FWD_ROUTE`` / ``DL4JTPU_DECODE_ATTN_ROUTE`` —
+   (per kernel: "fused_lstm", "fused_lstm_grad", "decode_attn",
+   "flash_attn")
+2. ``DL4JTPU_LSTM_FWD_ROUTE`` / ``DL4JTPU_LSTM_GRAD_ROUTE`` /
+   ``DL4JTPU_DECODE_ATTN_ROUTE`` / ``DL4JTPU_FLASH_ATTN_ROUTE`` —
    environment pins
 3. measured per-shape table (exact (B, T, H, dtype) match, seeded from
-   the shipped KERNELS_TPU.json via ``load_measurements``)
+   the shipped KERNELS_TPU.json via ``load_measurements`` plus any
+   persisted autotune table)
 4. heuristic: scan when ``B*H < 2048``; f32 additionally needs
    ``B*H > 2048`` and ``T < 128`` (both measured f32 losses above sit
-   on those boundaries); otherwise pallas
+   on those boundaries); otherwise pallas.  The backward defaults to
+   pallas (it wins at every validated shape the heuristic covers).
 
 The flash decode-step kernel (ops/flash_decode.py) routes through the
 same table: ``decode_attn_route`` defaults to pallas wherever the
@@ -36,6 +45,13 @@ kernel supports the shape (the decode step is bandwidth-bound on the
 KV cache at every capacity, and the kernel reads only ``pos+1`` of the
 ``C`` cached rows), with the same pin/env overrides for tests and
 rollbacks.
+
+The flash-attention training/inference forward (ops/flash_attention.py)
+routes via ``flash_attn_route``: 'pallas' means the flash kernel,
+'scan' means the dense XLA softmax-attention path (same vocabulary as
+``decode_attn_route``). Training asks for BOTH phases — the custom-vjp
+kernel commits forward and backward together, so a shape where the
+measured backward loses stays dense even if the forward wins.
 """
 
 import json
@@ -57,6 +73,18 @@ _MEASURED = {
     ("fused_lstm", 64, 32, 512, "float32"): "pallas",   # 1.07x
 }
 
+# backward-phase table, same key schema. The two literal rows are the
+# measured v5e LOSSES (every other validated shape wins — see the
+# grad_speedup column of KERNELS_TPU.json); the default is pallas.
+_MEASURED_GRAD = {
+    ("fused_lstm", 4, 16, 8, "bfloat16"): "scan",     # 0.24x
+    ("fused_lstm", 8, 32, 120, "bfloat16"): "scan",   # 0.40x
+}
+
+# flash-attention table: (phase, BH, T, Dh, causal) -> route. Seeded
+# from the shipped file's flash_attention rows at first lookup.
+_FLASH_MEASURED: Dict[tuple, str] = {}
+
 # measured latency/bandwidth crossover (see ops/lstm_pallas.py docstring)
 _MIN_BH = 2048
 
@@ -65,9 +93,11 @@ _file_loaded = False
 
 
 def set_route(kernel: str, route: Optional[str]) -> None:
-    """Pin every ``kernel`` forward to ``route`` ('pallas'/'scan' — for
-    ``decode_attn``, 'scan' means the dense reference step), or None to
-    restore data-driven routing. Test/debug hook."""
+    """Pin every ``kernel`` decision to ``route`` ('pallas'/'scan' — for
+    ``decode_attn``/``flash_attn``, 'scan' means the dense reference
+    path), or None to restore data-driven routing. Kernels:
+    "fused_lstm" (forward), "fused_lstm_grad" (backward),
+    "decode_attn", "flash_attn". Test/debug hook."""
     if route not in (None, "pallas", "scan"):
         raise ValueError(f"route must be pallas/scan/None, got {route!r}")
     if route is None:
@@ -76,18 +106,53 @@ def set_route(kernel: str, route: Optional[str]) -> None:
         _forced[kernel] = route
 
 
+def _grad_decision(row) -> Optional[str]:
+    """A row's backward route: explicit ``grad_route`` wins, else the
+    measured ``grad_speedup`` decides (pallas iff it beat the scan)."""
+    gr = row.get("grad_route")
+    if gr in ("pallas", "scan"):
+        return gr
+    gs = row.get("grad_speedup")
+    if gs is None:
+        return None
+    return "pallas" if gs > 1 else "scan"
+
+
 def load_measurements(results, kernel: str = "fused_lstm") -> int:
     """Merge bench rows (KERNELS_TPU.json ``results`` schema) into the
-    table: a row routes to pallas iff its measured ``fwd_speedup`` > 1.
-    Returns the number of rows absorbed."""
+    tables: a row routes its forward to pallas iff its measured
+    ``fwd_speedup`` > 1, and its backward by ``grad_route`` /
+    ``grad_speedup`` the same way. Returns the number of rows absorbed
+    (a row counts once even when it feeds both phases)."""
     n = 0
     for row in results:
-        if row.get("kernel") != kernel or row.get("fwd_speedup") is None:
+        if row.get("kernel") != kernel:
+            continue
+        if kernel == "flash_attention":
+            key = (row.get("BH"), row.get("T"), row.get("Dh"),
+                   bool(row.get("causal")))
+            hit = False
+            if row.get("fwd_speedup") is not None:
+                _FLASH_MEASURED[("fwd",) + key] = \
+                    "pallas" if row["fwd_speedup"] > 1 else "scan"
+                hit = True
+            grad = _grad_decision(row)
+            if grad is not None:
+                _FLASH_MEASURED[("grad",) + key] = grad
+                hit = True
+            n += 1 if hit else 0
             continue
         key = (kernel, row.get("B"), row.get("T"), row.get("H"),
                row.get("dtype"))
-        _MEASURED[key] = "pallas" if row["fwd_speedup"] > 1 else "scan"
-        n += 1
+        hit = False
+        if row.get("fwd_speedup") is not None:
+            _MEASURED[key] = "pallas" if row["fwd_speedup"] > 1 else "scan"
+            hit = True
+        grad = _grad_decision(row)
+        if grad is not None:
+            _MEASURED_GRAD[key] = grad
+            hit = True
+        n += 1 if hit else 0
     return n
 
 
@@ -108,13 +173,40 @@ def load_measurements_file(path: Optional[str] = None) -> int:
 
 
 def _ensure_file_measurements() -> None:
-    """Lazy one-shot load of the shipped measurement file, so the per-shape
-    choice is measurement-driven for every dtype it covers (the bf16
-    small-shape losses included) without any caller wiring."""
+    """Lazy one-shot load of the shipped measurement file PLUS any
+    persisted autotune table for the current backend (the autotune rows
+    merge last, so first-use measurements on the actual hardware
+    override the shipped v5e numbers)."""
     global _file_loaded
     if not _file_loaded:
         _file_loaded = True
         load_measurements_file()
+        try:
+            from deeplearning4j_tpu.exec import autotune
+            autotune.load_persisted_into_routing()
+        except Exception:
+            pass        # a corrupt table must never take down routing
+
+
+def _reset_measurement_cache() -> None:
+    """Forget the lazy-load latch (tests re-point the autotune table)."""
+    global _file_loaded
+    _file_loaded = False
+
+
+def _maybe_autotune(kernel: str, shape_key: tuple) -> Optional[str]:
+    """First-use measurement hook: when DL4JTPU_AUTOTUNE is on and the
+    tables have no row for this shape, measure kernel-vs-reference on
+    the actual backend, persist, and return the fresh route (None when
+    autotuning is off or the measurement could not run)."""
+    if os.environ.get("DL4JTPU_AUTOTUNE", "").strip().lower() \
+            not in ("1", "true", "on", "yes"):
+        return None
+    try:
+        from deeplearning4j_tpu.exec import autotune
+        return autotune.ensure_measured(kernel, shape_key)
+    except Exception:
+        return None
 
 
 def lstm_fwd_route(b: int, h: int, t: Optional[int] = None,
@@ -137,12 +229,83 @@ def lstm_fwd_route(b: int, h: int, t: Optional[int] = None,
         hit = _MEASURED.get(("fused_lstm", b, t, h, str(dtype)))
         if hit is not None:
             return hit
+        hit = _maybe_autotune("fused_lstm_fwd", (b, t, h, str(dtype)))
+        if hit is not None:
+            return hit
     if b * h < _MIN_BH:
         return "scan"
     if str(dtype) == "float32" and (b * h <= _MIN_BH
                                     or (t is not None and t >= 128)):
         return "scan"
     return "pallas"
+
+
+def lstm_grad_route(b: int, h: int, t: Optional[int] = None,
+                    dtype: Optional[str] = None,
+                    backend: Optional[str] = None) -> str:
+    """Route the fused-LSTM backward for one shape: 'pallas' (the
+    reverse-grid kernel) or 'scan' (the equivalent reverse lax.scan,
+    ops/lstm_pallas.py ``_scan_bwd``). Default is pallas — the backward
+    kernel wins at every validated shape except the measured bf16
+    losses in the table — with the same pin/env/measured precedence as
+    the forward."""
+    forced = _forced.get("fused_lstm_grad")
+    if forced is not None:
+        return forced
+    env = os.environ.get("DL4JTPU_LSTM_GRAD_ROUTE", "").strip().lower()
+    if env in ("pallas", "scan"):
+        return env
+    if backend is not None and backend != "tpu":
+        return "scan"
+    if t is not None and dtype is not None:
+        _ensure_file_measurements()
+        hit = _MEASURED_GRAD.get(("fused_lstm", b, t, h, str(dtype)))
+        if hit is not None:
+            return hit
+        hit = _maybe_autotune("fused_lstm_grad", (b, t, h, str(dtype)))
+        if hit is not None:
+            return hit
+    return "pallas"
+
+
+def flash_attn_route(bh: int, t: int, dh: int, causal: bool,
+                     train: bool = False,
+                     backend: Optional[str] = None,
+                     min_t: int = 4096) -> str:
+    """Route the flash-attention forward at the layer seam: 'pallas'
+    (ops/flash_attention.py) or 'scan' (the dense XLA path).
+
+    ``train=True`` commits the custom-vjp pair, so the decision needs
+    BOTH phases to win: a measured 'scan' on either the fwd or grad row
+    keeps the shape dense. Without measurements the seam falls back to
+    the ``t >= min_t`` crossover (MIN_SEQ_FOR_AUTO_ROUTE, measured on
+    v5e — the caller passes 0 in interpret mode so CPU tests exercise
+    the kernel at any length)."""
+    forced = _forced.get("flash_attn")
+    if forced is not None:
+        return forced
+    env = os.environ.get("DL4JTPU_FLASH_ATTN_ROUTE", "").strip().lower()
+    if env in ("pallas", "scan"):
+        return env
+    if backend is not None and backend != "tpu":
+        return "scan"
+    if backend == "tpu":
+        # measured rows only steer REAL compiled routing; interpret-mode
+        # callers (backend=None) keep the deterministic min_t gate so the
+        # CPU parity tests always exercise the kernel
+        _ensure_file_measurements()
+        key = (bh, t, dh, bool(causal))
+        phases = ("fwd", "grad") if train else ("fwd",)
+        hits = [_FLASH_MEASURED.get((ph,) + key) for ph in phases]
+        if any(h == "scan" for h in hits):
+            return "scan"
+        if all(h == "pallas" for h in hits):
+            return "pallas"
+        hit = _maybe_autotune("flash_attention",
+                              (bh, t, dh, bool(causal), bool(train)))
+        if hit is not None:
+            return hit
+    return "pallas" if t >= min_t else "scan"
 
 
 def decode_attn_route(c: Optional[int] = None, dh: Optional[int] = None,

@@ -58,6 +58,8 @@ class ComputationGraph:
         self._output_fn = None
         self._serving = None          # bucketed inference engine (lazy)
         self._transforms = None
+        self._fused = None            # fused update plan (nn/fused_update.py)
+        self._update_step = None      # standalone donated update program
         self._compile_count = 0       # train programs traced (see _note_compile)
         self._train_mon = None        # lazy TrainMonitor (metric children)
         self._exec = None             # execution core (lazy; exec/executor.py)
@@ -92,26 +94,54 @@ class ComputationGraph:
         return self
 
     def _build_optimizer(self):
+        import json
+        from deeplearning4j_tpu.nn.fused_update import (build_fused_update,
+                                                        fused_update_enabled)
         gc = self.conf.global_conf
         self._transforms = {}
+        group_keys = {}
         for name, p in self.params.items():
             l = self.conf.nodes[name].layer
             if isinstance(l, FrozenLayer) or not p:
                 self._transforms[name] = optax.set_to_zero()
+                group_keys[name] = None
             else:
-                self._transforms[name] = make_gradient_transform(l.updater or gc.updater)
+                upd = l.updater or gc.updater
+                self._transforms[name] = make_gradient_transform(upd)
+                group_keys[name] = json.dumps(upd.to_dict(), sort_keys=True)
         self.opt_state = {n: t.init(self.params[n])
                           for n, t in self._transforms.items()}
+        self._fused = None
+        if fused_update_enabled():
+            self._fused = build_fused_update(
+                self.params, self._transforms, group_keys,
+                {n: self.conf.nodes[n].layer.apply_constraints
+                 for n in self.params})
         self._train_step_cache = {}
         self._scan_fit = None
         self._output_fn = None
         self._serving = None
+        self._update_step = None
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
         return self
 
     # ----------------------------------------------------------- forward core
+    def _compute_dtype(self, train):
+        """The forward's compute dtype: the model's own ``compute_dtype``
+        when configured, else the executor's train-precision policy (bf16
+        compute, f32 accumulation — docs/TRAINING_PERF.md) on the fit path
+        of f32 models. None means no cast. Read at trace time."""
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            return _dtype_of(gc.compute_dtype)
+        if train:
+            dt = self._executor.train_dtype
+            if dt is not None and _dtype_of(gc.dtype) == jnp.float32:
+                return dt
+        return None
+
     def _forward(self, params, state, inputs: List, *, train, rng, masks=None,
                  carries=None):
         """Forward along topo order. Returns (activations dict, new_state,
@@ -123,12 +153,13 @@ class ComputationGraph:
         acts: Dict[str, Any] = {}
         new_state = dict(state)
         new_carries = dict(carries) if carries is not None else None
-        if gc.compute_dtype:
-            params = _cast_floats(params, _dtype_of(gc.compute_dtype))
+        cdt = self._compute_dtype(train)
+        if cdt is not None:
+            params = _cast_floats(params, cdt)
         for i, n in enumerate(self.conf.network_inputs):
             x = inputs[i]
-            if gc.compute_dtype:
-                x = x.astype(_dtype_of(gc.compute_dtype))
+            if cdt is not None:
+                x = x.astype(cdt)
             acts[n] = x
         for idx, name in enumerate(self.conf.topological_order):
             node = self.conf.nodes[name]
@@ -166,7 +197,7 @@ class ComputationGraph:
                 if st is not None:
                     new_state[name] = st
             acts[name] = y
-        if gc.compute_dtype:
+        if cdt is not None:
             # persistent state (BN stats) keeps its storage dtype
             new_state = {
                 k: _restore_dtypes(v, state[k])
@@ -198,8 +229,7 @@ class ComputationGraph:
                 train=True, rng=lrng)
         for name, p in params.items():
             total = total + self.conf.nodes[name].layer.reg_loss(p)
-        gc = self.conf.global_conf
-        if gc.compute_dtype:
+        if self._compute_dtype(True) is not None:
             total = total.astype(jnp.float32)
         if carries is not None:
             return total, (new_state, new_carries)
@@ -248,8 +278,18 @@ class ComputationGraph:
         return self._loss(params, state, inputs, labels, rng, masks,
                           label_masks)
 
-    def _dp_apply_updates(self, params, opt_state, grads):
+    def _dp_apply_updates(self, params, opt_state, grads, fused=None):
+        """Fused flat update by default (nn/fused_update.py — bitwise-equal
+        to the per-node loop below, kept as the DL4JTPU_FUSED_UPDATE=0
+        fallback and parity oracle). Tensor-parallel callers pass
+        ``fused=False``: raveling row- and column-sharded leaves into one
+        vector would gather every shard (and trips a GSPMD mis-partition
+        on mixed-axis concat) — the per-node loop keeps TP placement."""
         grads = self._normalize_grads(grads)
+        if fused is None:
+            fused = self._executor.model_size <= 1
+        if fused and self._fused is not None:
+            return self._fused.apply(params, opt_state, grads)
         new_params, new_opt = {}, {}
         for name, p in params.items():
             if not p:
@@ -829,13 +869,44 @@ class ComputationGraph:
         grads = jax.tree_util.tree_map(jnp.add, grads, reg_grads)
         return grads, new_state
 
+    def _apply_updates_jitted(self):
+        """The standalone grad→update→apply program: one compile per
+        (model, updater), params + opt-state donated so XLA updates in
+        place. Traces the same `_dp_apply_updates` math the train step
+        embeds (fused flat path by default)."""
+        if self._update_step is None:
+            def upd(params, opt_state, grads):
+                self._note_compile()
+                return self._dp_apply_updates(params, opt_state, grads)
+
+            from deeplearning4j_tpu import exec as ex
+            self._update_step = self._executor.jit(
+                upd, in_specs=(ex.PARAMS, ex.OPT, ex.PARAMS),
+                out_specs=(ex.PARAMS, ex.OPT), donate_argnums=(0, 1))
+        return self._update_step
+
+    def apply_external_updates(self, grads):
+        """One updater step from externally-computed gradients via the
+        donated fused-update program (registered as ``apply_updates`` in
+        the /programs registry)."""
+        step = self._apply_updates_jitted()
+        c0, t0 = self._compile_count, time.perf_counter()
+        self.params, self.opt_state = step(self.params, self.opt_state,
+                                           grads)
+        if self._compile_count > c0:
+            self._executor.register_program(
+                self._prog_caller, "apply_updates", step,
+                (self.params, self.opt_state, grads),
+                compile_seconds=time.perf_counter() - t0)
+        return self
+
     def fit_external(self, inputs, epsilons):
         """One updater step driven by external epsilons (the training half
         of the externalEpsilons contract). Updates params, updater state and
-        layer state (e.g. batchnorm running stats) like fit()."""
+        layer state (e.g. batchnorm running stats) like fit(). The update
+        runs through the standalone donated program, not an eager loop."""
         grads, new_state = self.backprop_external(inputs, epsilons)
-        self.params, self.opt_state = self._dp_apply_updates(
-            self.params, self.opt_state, grads)
+        self.apply_external_updates(grads)
         self.state = new_state
         self.iteration += 1
         return self

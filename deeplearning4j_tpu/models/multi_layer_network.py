@@ -64,6 +64,8 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._serving = None          # bucketed inference engine (lazy)
         self._transforms = None
+        self._fused = None            # fused update plan (nn/fused_update.py)
+        self._update_step = None      # standalone donated update program
         self._compile_count = 0       # train programs traced (see _note_compile)
         self._train_mon = None        # lazy TrainMonitor (metric children)
         self._exec = None             # execution core (lazy; exec/executor.py)
@@ -94,19 +96,33 @@ class MultiLayerNetwork:
         return self
 
     def _build_optimizer(self):
+        import json
+        from deeplearning4j_tpu.nn.fused_update import (build_fused_update,
+                                                        fused_update_enabled)
         gc = self.conf.global_conf
         self._transforms = []
-        for l, p in zip(self.layers, self.params):
+        group_keys = {}
+        for i, (l, p) in enumerate(zip(self.layers, self.params)):
             upd = l.updater or gc.updater
             if isinstance(l, FrozenLayer) or not p:
                 self._transforms.append(optax.set_to_zero())
+                group_keys[i] = None
             else:
                 self._transforms.append(make_gradient_transform(upd))
+                group_keys[i] = json.dumps(upd.to_dict(), sort_keys=True)
         self.opt_state = [t.init(p) for t, p in zip(self._transforms, self.params)]
+        self._fused = None
+        if fused_update_enabled():
+            self._fused = build_fused_update(
+                dict(enumerate(self.params)),
+                dict(enumerate(self._transforms)), group_keys,
+                {i: l.apply_constraints
+                 for i, l in enumerate(self.layers)})
         self._train_step = None  # force re-trace
         self._scan_fit = None
         self._output_fn = None
         self._serving = None
+        self._update_step = None
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -117,13 +133,27 @@ class MultiLayerNetwork:
         return self
 
     # ----------------------------------------------------------- forward core
+    def _compute_dtype(self, train):
+        """The forward's compute dtype: the model's own ``compute_dtype``
+        when configured, else the executor's train-precision policy (bf16
+        compute, f32 accumulation — docs/TRAINING_PERF.md) on the fit path
+        of f32 models. None means no cast. Read at trace time."""
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            return _dtype_of(gc.compute_dtype)
+        if train:
+            dt = self._executor.train_dtype
+            if dt is not None and _dtype_of(gc.dtype) == jnp.float32:
+                return dt
+        return None
+
     def _forward(self, params, state, x, *, train, rng, mask=None, carries=None,
                  upto=None):
         """Pure forward through layers [0, upto). Returns (act, new_states,
         new_carries)."""
         gc = self.conf.global_conf
-        if gc.compute_dtype:
-            cdt = _dtype_of(gc.compute_dtype)
+        cdt = self._compute_dtype(train)
+        if cdt is not None:
             x = x.astype(cdt)
             params = _cast_floats(params, cdt)
         n = len(self.layers) if upto is None else upto
@@ -160,7 +190,7 @@ class MultiLayerNetwork:
             if x.ndim == 2:
                 mask = None  # sequence collapsed to per-example
             i += 1
-        if gc.compute_dtype:
+        if cdt is not None:
             # keep persistent layer state (e.g. BN running stats) at its
             # storage dtype so dtypes are stable across steps
             new_states = _restore_dtypes(new_states, list(state))
@@ -188,7 +218,7 @@ class MultiLayerNetwork:
         for l, p in zip(self.layers, params):
             reg = reg + l.reg_loss(p)
         loss = loss + reg
-        if gc.compute_dtype:
+        if self._compute_dtype(True) is not None:
             loss = loss.astype(jnp.float32)
         return loss, (new_states, new_carries)
 
@@ -223,10 +253,23 @@ class MultiLayerNetwork:
         loss, (new_state, _) = self._loss(params, state, x, y, rng, mf, ml)
         return loss, new_state
 
-    def _dp_apply_updates(self, params, opt_state, grads):
-        """Normalize grads, run updaters, apply constraints — one layer at a
-        time (same math as the single-device train step)."""
+    def _dp_apply_updates(self, params, opt_state, grads, fused=None):
+        """Normalize grads, run updaters, apply constraints. Default path:
+        the fused flat program (nn/fused_update.py — bitwise-equal to the
+        per-layer loop below, which remains as the DL4JTPU_FUSED_UPDATE=0
+        fallback and the parity oracle). Tensor-parallel callers pass
+        ``fused=False``: raveling row- and column-sharded leaves into one
+        vector would gather every shard (and trips a GSPMD mis-partition
+        on mixed-axis concat) — the per-leaf loop keeps TP placement."""
         grads = self._normalize_grads(grads)
+        if fused is None:
+            fused = self._executor.model_size <= 1
+        if fused and self._fused is not None:
+            n = len(params)
+            pd, od = self._fused.apply(dict(enumerate(params)),
+                                       dict(enumerate(opt_state)),
+                                       dict(enumerate(grads)))
+            return [pd[i] for i in range(n)], [od[i] for i in range(n)]
         new_params, new_opt = [], []
         for i, (l, t) in enumerate(zip(self.layers, self._transforms)):
             if not params[i]:
@@ -238,6 +281,38 @@ class MultiLayerNetwork:
             new_params.append(l.apply_constraints(p))
             new_opt.append(o)
         return new_params, new_opt
+
+    def _apply_updates_jitted(self):
+        """The standalone grad→update→apply program: one compile per
+        (model, updater), params + opt-state donated so XLA updates in
+        place. External-gradient callers go through this instead of an
+        eager per-leaf loop; it traces the same `_dp_apply_updates` math
+        the train step embeds."""
+        if self._update_step is None:
+            def upd(params, opt_state, grads):
+                self._note_compile()
+                return self._dp_apply_updates(params, opt_state, grads)
+
+            from deeplearning4j_tpu import exec as ex
+            self._update_step = self._executor.jit(
+                upd, in_specs=(ex.PARAMS, ex.OPT, ex.PARAMS),
+                out_specs=(ex.PARAMS, ex.OPT), donate_argnums=(0, 1))
+        return self._update_step
+
+    def apply_external_updates(self, grads):
+        """One updater step from externally-computed gradients via the
+        donated fused-update program (registered as ``apply_updates`` in
+        the /programs registry)."""
+        step = self._apply_updates_jitted()
+        c0, t0 = self._compile_count, time.perf_counter()
+        self.params, self.opt_state = step(self.params, self.opt_state,
+                                           grads)
+        if self._compile_count > c0:
+            self._executor.register_program(
+                self._prog_caller, "apply_updates", step,
+                (self.params, self.opt_state, grads),
+                compile_seconds=time.perf_counter() - t0)
+        return self
 
     def _note_compile(self):
         # called from inside jitted train-step bodies: runs only while jit

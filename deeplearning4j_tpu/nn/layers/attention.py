@@ -22,25 +22,36 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 
 
 def scaled_dot_product_attention(q, k, v, *, causal=False, mask=None,
-                                 q_offset=0, k_offset=0):
+                                 q_offset=0, k_offset=0, train=False):
     """q/k/v: (B, T, H, Dh). mask: (B, Tk) key padding mask. Offsets give
-    global positions for causal masking of sequence blocks."""
+    global positions for causal masking of sequence blocks. ``train``
+    feeds the route decision: the flash kernel is a custom-vjp pair, so a
+    training call commits BOTH its forward and backward — routing asks
+    for both phases (exec/routing.py flash_attn_route)."""
     from deeplearning4j_tpu import ops
     if (mask is None and q_offset == 0 and k_offset == 0
             and q.shape == k.shape and v.shape == q.shape
             and ops.helpers_enabled()):
         from deeplearning4j_tpu.ops.flash_attention import (
             supported, MIN_SEQ_FOR_AUTO_ROUTE)
+        from deeplearning4j_tpu.exec.routing import flash_attn_route
+        B, T, H, Dh = q.shape
         # interpreter mode (CPU tests) exercises the kernel at any length;
-        # compiled mode routes only where flash beats XLA (long sequences)
-        min_t = 0 if ops.interpret_mode() else MIN_SEQ_FOR_AUTO_ROUTE
-        if supported(q.shape[1], q.shape[-1], min_t=min_t):
-            B, T, H, Dh = q.shape
+        # compiled mode routes per (shape, backend) measurement with the
+        # long-sequence crossover as the no-data fallback — the SAME
+        # decision for the training and inference forward
+        interp = ops.interpret_mode()
+        min_t = 0 if interp else MIN_SEQ_FOR_AUTO_ROUTE
+        backend = None if interp else jax.default_backend()
+        if (supported(T, Dh, min_t=0)
+                and flash_attn_route(B * H, T, Dh, causal, train=train,
+                                     backend=backend,
+                                     min_t=min_t) == "pallas"):
             dt = q.dtype
             fold = lambda a: (a.transpose(0, 2, 1, 3)
                               .reshape(B * H, T, Dh).astype(jnp.float32))
             o = ops.flash_attention(fold(q), fold(k), fold(v), causal,
-                                    ops.interpret_mode())
+                                    interp)
             return (o.reshape(B, H, T, Dh).transpose(0, 2, 1, 3).astype(dt))
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -113,7 +124,8 @@ class MultiHeadAttention(Layer):
         x = self.maybe_dropout(x, train=train, rng=rng)
         B, T, _ = x.shape
         q, k, v = self._project(params, x)
-        o = scaled_dot_product_attention(q, k, v, causal=self.causal, mask=mask)
+        o = scaled_dot_product_attention(q, k, v, causal=self.causal,
+                                         mask=mask, train=train)
         o = o.reshape(B, T, self.n_out) @ params["Wo"]
         if self.has_bias:
             o = o + params["bo"]

@@ -29,8 +29,10 @@ cs sequence was a dead output: the layer uses hs + the last carry), and
 recomputes tanh nor materializes a shifted copy of cs. At small B*H the
 loop is latency-bound instead and XLA's scan codegen beats Mosaic's, so
 ``fused_lstm_sequence`` routes the *forward* to an equivalent lax.scan
-below a measured size threshold while keeping the Pallas backward (which
-wins at every validated shape — see KERNELS_TPU.json).
+below a measured size threshold. The backward routes the same way
+(``_scan_bwd`` mirrors the reverse kernel's math): the Pallas backward
+wins at most validated shapes, but KERNELS_TPU.json carries two
+measured bf16 losses — see exec/routing.py ``lstm_grad_route``.
 
 Supported config (like cuDNN's CUDNN_LSTM mode): sigmoid gates, tanh cell
 activation, no peepholes, no step masking. The layer falls back to the
@@ -366,6 +368,63 @@ def _scan_fwd(gate_in, rw, h0, c0, *, save_reserve):
     return outs, cT.astype(dt)
 
 
+# ------------------------------------------------------ scan-routed backward
+
+def _scan_bwd(gates, tc, cprev, rw, dhs, dcT):
+    """Reverse-time lax.scan on the backward kernel's exact math (same
+    f32 carries, same dz/dh0/dc0 contract as ``_bwd_call``). Used where
+    the measured table says the reverse-grid kernel loses — the two
+    validated bf16 losses are latency-bound small shapes, the same
+    regime where the forward scans (see exec/routing.py)."""
+    T, B, G = gates.shape
+    H = G // 4
+
+    def step(carry, inp):
+        dh_rec, dc_carry = carry
+        gates_t, tc_t, cp_t, dhs_t = inp
+        i = gates_t[:, 0 * H:1 * H].astype(f32)
+        f = gates_t[:, 1 * H:2 * H].astype(f32)
+        o = gates_t[:, 2 * H:3 * H].astype(f32)
+        g = gates_t[:, 3 * H:4 * H].astype(f32)
+        tc_ = tc_t.astype(f32)
+        cp = cp_t.astype(f32)
+
+        dh = dhs_t.astype(f32) + dh_rec
+        do = dh * tc_
+        dc = dc_carry + dh * o * (1.0 - tc_ * tc_)
+        di = dc * g
+        dg = dc * i
+        df = dc * cp
+
+        dz = jnp.concatenate([di * i * (1.0 - i), df * f * (1.0 - f),
+                              do * o * (1.0 - o), dg * (1.0 - g * g)],
+                             axis=-1)
+        dzd = dz if rw.dtype == f32 else dz.astype(rw.dtype)
+        dh_rec = lax.dot_general(dzd, rw, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=f32)
+        return (dh_rec, dc * f), dz.astype(gates.dtype)
+
+    (dh0, dc0), dz = lax.scan(
+        step, (jnp.zeros((B, H), f32), dcT.astype(f32)),
+        (gates, tc, cprev, dhs), reverse=True)
+    return dz, dh0, dc0
+
+
+def use_pallas_bwd(b, h, t=None, dtype=None, interpret=False):
+    """Backward routing: the reverse-grid Pallas kernel vs the reverse
+    lax.scan above. Measurement-driven exactly like the forward
+    (exec/routing.py ``lstm_grad_route`` — KERNELS_TPU.json
+    ``grad_route``/``grad_speedup`` rows plus autotune), default
+    pallas. Interpret mode skips the measured table (CPU tests must
+    keep exercising the kernel) but still honors pins/env, so either
+    side is forceable on any backend."""
+    from deeplearning4j_tpu.exec.routing import lstm_grad_route
+    if interpret:
+        return lstm_grad_route(b, h) == "pallas"
+    return lstm_grad_route(b, h, t=t, dtype=dtype,
+                           backend=jax.default_backend()) == "pallas"
+
+
 # ------------------------------------------------------------- public op
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -403,9 +462,17 @@ def _fused_fwd(gate_in, rw, h0, c0, interpret):
 def _fused_bwd(interpret, res, grads):
     rw, h0, c0, hs, tc, cprev, gates = res
     dhs, dcT = grads
-    dz, dh0, dc0 = _bwd_call(gates, tc, cprev, rw,
-                             dhs.astype(gates.dtype),
-                             dcT.astype(gates.dtype), interpret=interpret)
+    B, H = h0.shape
+    if use_pallas_bwd(B, H, t=gates.shape[0], dtype=gates.dtype,
+                      interpret=interpret):
+        dz, dh0, dc0 = _bwd_call(gates, tc, cprev, rw,
+                                 dhs.astype(gates.dtype),
+                                 dcT.astype(gates.dtype),
+                                 interpret=interpret)
+    else:
+        dz, dh0, dc0 = _scan_bwd(gates, tc, cprev, rw,
+                                 dhs.astype(gates.dtype),
+                                 dcT.astype(gates.dtype))
     # weight gradient = big batched GEMMs (cudnnRNNBackwardWeights parity);
     # h_prev is expressed as slices of hs (+ the h0 rank-1 term) instead of
     # materializing a shifted copy.

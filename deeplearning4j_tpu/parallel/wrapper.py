@@ -138,8 +138,11 @@ class ParallelWrapper:
         (loss, new_state), grads = jax.value_and_grad(
             self.model._dp_loss, has_aux=True)(params, state, x, y, rng,
                                                pad_mask, mf, ml)
-        new_params, new_opt = self.model._dp_apply_updates(params, opt_state,
-                                                           grads)
+        # TP meshes take the per-leaf path (see _dp_apply_updates: the
+        # fused flat program would gather every TP shard)
+        new_params, new_opt = self.model._dp_apply_updates(
+            params, opt_state, grads,
+            fused=None if self.model_axis is None else False)
         return new_params, new_state, new_opt, loss
 
     def _fold_iteration(self, it):

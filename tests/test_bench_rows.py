@@ -28,6 +28,20 @@ def test_quantized_row_fast():
     assert set(row["serving_qps"]) == {"f32", "int8", "fp8"}
 
 
+def test_train_perf_row_fast():
+    row = bench.bench_train_perf(fast=True)
+    # the function itself asserts fused-vs-per-leaf bitwise parity, the
+    # pinned bf16 loss tolerance, and the MFU column's presence; here we
+    # pin the row shape the bench driver publishes
+    assert row["unit"] == "ratio"
+    assert row["fused_bitwise"] is True
+    assert row["mfu"] is not None
+    bf16 = next(l for l in bench._EMITTED
+                if "bf16 policy" in l["metric"])
+    assert bf16["bf16_loss_delta"] <= bf16["bf16_loss_tol"]
+    assert bf16["mfu"] is not None
+
+
 def test_ladder_row_fast():
     row = bench.bench_ladder(fast=True)
     assert row["unit"] == "percent"
