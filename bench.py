@@ -86,6 +86,9 @@ BARS = {
     "router": 1000.0,         # req/sec aggregate through a 3-replica
                               # routed tier (ParallelInference behind a
                               # round-robin LB, small-model requests)
+    "kv_prefix": 2.0,         # x, effective prefill throughput of a
+                              # shared-prefix storm with the prefix cache
+                              # vs without (the row's asserted floor)
 }
 
 V5E_PEAK_FLOPS = 197e12       # bf16 MXU peak of one v5e chip (MFU denominator)
@@ -1089,6 +1092,172 @@ def bench_decode(max_len=256, gen_tokens=128, streams=32):
          "warmup_seconds": round(eng.warmup_seconds, 2)})
 
 
+def bench_kv_storm(fast=False):
+    """Paged-KV storm row: mixed long-prefill / short-decode traffic on a
+    transformer LM through a dense engine vs a paged engine with chunked
+    prefill (docs/DECODING.md "Paged KV"). The dense engine advances a
+    prompt ONE position per batched step, so a long prefill occupies its
+    slot for ``plen`` iterations and short requests queue behind the slot
+    churn; chunked prefill consumes ``chunk_tokens`` positions per
+    iteration, so the same traffic turns slots over ~K times faster.
+
+    Asserted: greedy outputs bitwise-equal between the two engines for
+    every request, ONE compiled step program + ≤2 kv side programs, pool
+    occupancy drained to zero; (full mode only) paged aggregate
+    tokens/sec ≥ 1.2x dense AND short-request decode p99 no worse —
+    CPU wall-clock in the fast tier proves nothing."""
+    from deeplearning4j_tpu.serving import DecodeEngine
+    from deeplearning4j_tpu.zoo.simple import TinyTransformer
+
+    vocab = 29
+    if fast:
+        max_len, bs, chunk = 32, 8, 8
+        slots, n_long, n_short = 2, 2, 3
+        long_len, short_len, long_new, short_new = 24, 2, 4, 4
+    else:
+        max_len, bs, chunk = 128, 16, 32
+        slots, n_long, n_short = 4, 6, 12
+        long_len, short_len, long_new, short_new = 96, 4, 8, 24
+    net = TinyTransformer(vocab_size=vocab, n_layers=2, d_model=32,
+                          n_heads=4, max_len=max_len).init()
+    rs = np.random.RandomState(17)
+    reqs = ([([int(t) for t in rs.randint(0, vocab, long_len)], long_new)
+             for _ in range(n_long)]
+            + [([int(t) for t in rs.randint(0, vocab, short_len)],
+                short_new) for _ in range(n_short)])
+
+    def storm_lat(**kw):
+        eng = DecodeEngine(net, slots=slots, max_len=max_len, **kw)
+        eng.warmup()
+        eng.start()
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=mn) for p, mn in reqs]
+        done_at = [None] * len(futs)
+        pending = set(range(len(futs)))
+        while pending:
+            for i in list(pending):
+                if futs[i].done():
+                    done_at[i] = time.perf_counter() - t0
+                    pending.remove(i)
+            time.sleep(0.001)
+        wall = time.perf_counter() - t0
+        outs = [f.result()["tokens"] for f in futs]
+        short_lat = [done_at[i] / reqs[i][1]
+                     for i in range(len(reqs))
+                     if len(reqs[i][0]) == short_len]
+        st = eng.stats()
+        eng.stop()
+        total = sum(len(t) for t in outs)
+        return outs, total / wall, np.percentile(short_lat, 99), st
+
+    # per-request completion latency needs submit-relative timestamps, so
+    # the storm polls futures instead of blocking on them in order
+    d_out, d_tps, d_p99, d_st = storm_lat()
+    p_out, p_tps, p_p99, p_st = storm_lat(kv="paged", kv_block_size=bs,
+                                          prefix_cache=False,
+                                          chunk_tokens=chunk)
+    assert d_out == p_out, "paged storm output diverged from dense"
+    assert d_st["compiled_programs"] == 1
+    assert p_st["compiled_programs"] == 1
+    assert p_st["kv"]["kv_programs"] <= 2
+    assert p_st["kv"]["prefill_chunks"] > 0
+    assert p_st["kv"]["blocks_in_use"] == 0
+    if not fast:
+        assert p_tps >= 1.2 * d_tps, (
+            f"paged+chunked storm {p_tps:.1f} tok/s < 1.2x dense "
+            f"{d_tps:.1f}")
+        assert p_p99 <= d_p99, (
+            f"short-decode p99 {p_p99 * 1e3:.1f}ms worse than dense "
+            f"{d_p99 * 1e3:.1f}ms")
+    return _emit(
+        f"paged-KV storm ({n_long}x{long_len}-tok prefill + {n_short} "
+        f"short decodes, chunk={chunk})", p_tps, "tokens/sec",
+        BARS["decode"],
+        {"dense_tokens_per_sec": round(d_tps, 1),
+         "speedup_paged_vs_dense": round(p_tps / d_tps, 2),
+         "short_decode_p99_ms_dense": round(d_p99 * 1e3, 2),
+         "short_decode_p99_ms_paged": round(p_p99 * 1e3, 2),
+         "prefill_chunks": p_st["kv"]["prefill_chunks"],
+         "compiled_programs": [d_st["compiled_programs"],
+                               p_st["compiled_programs"]],
+         "kv_programs": p_st["kv"]["kv_programs"],
+         "outputs_bitwise_equal": True})
+
+
+def bench_kv_prefix(fast=False):
+    """Shared-prefix storm row: many requests behind one long system
+    prompt, paged engine with the prefix cache ON vs OFF. With the cache,
+    every request after the first claims the published prefix blocks
+    read-only (refcount++) and skips their prefill; effective prefill
+    throughput — prompt tokens admitted per second of storm wall —
+    multiplies.
+
+    Asserted: every output bitwise-equal to the cache-off run, R-1
+    prefix hits, ≥ (R-1) x prefix tokens saved, pool drained; (full mode
+    only) effective prefill throughput ≥ 2x the no-cache engine."""
+    from deeplearning4j_tpu.serving import DecodeEngine
+    from deeplearning4j_tpu.zoo.simple import TinyTransformer
+
+    vocab = 29
+    if fast:
+        max_len, bs, chunk, slots, R = 64, 16, 8, 2, 4
+        shared_len, uniq_len, max_new = 32, 8, 2
+    else:
+        max_len, bs, chunk, slots, R = 128, 16, 16, 4, 16
+        shared_len, uniq_len, max_new = 112, 8, 1
+    net = TinyTransformer(vocab_size=vocab, n_layers=2, d_model=32,
+                          n_heads=4, max_len=max_len).init()
+    rs = np.random.RandomState(41)
+    system = [int(t) for t in rs.randint(0, vocab, shared_len)]
+    prompts = [system + [int(t) for t in rs.randint(0, vocab, uniq_len)]
+               for _ in range(R)]
+
+    def storm(prefix_cache):
+        eng = DecodeEngine(net, slots=slots, max_len=max_len, kv="paged",
+                           kv_block_size=bs, prefix_cache=prefix_cache,
+                           chunk_tokens=chunk)
+        eng.warmup()
+        eng.start()
+        t0 = time.perf_counter()
+        # the first request completes (publishing the prefix blocks)
+        # before the fan-out — the steady-state shape of system-prompt
+        # traffic, and identical scheduling for both engines
+        first = eng.generate(prompts[0], max_new_tokens=max_new)
+        futs = [eng.submit(p, max_new_tokens=max_new)
+                for p in prompts[1:]]
+        outs = [first["tokens"]] + [f.result(timeout=600)["tokens"]
+                                    for f in futs]
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        eng.stop()
+        eff = sum(len(p) for p in prompts) / wall
+        return outs, eff, st
+
+    cold_out, cold_eff, cold_st = storm(False)
+    warm_out, warm_eff, warm_st = storm(True)
+    assert warm_out == cold_out, "prefix reuse changed decode output"
+    kv = warm_st["kv"]
+    assert kv["prefix_hits"] == R - 1
+    assert kv["prefix_tokens_saved"] >= (R - 1) * (shared_len - bs)
+    assert kv["blocks_in_use"] == 0
+    assert warm_st["compiled_programs"] == 1
+    assert kv["kv_programs"] <= 2
+    speedup = warm_eff / cold_eff
+    if not fast:
+        assert speedup >= 2.0, (
+            f"shared-prefix effective prefill {warm_eff:.0f} tok/s is "
+            f"only {speedup:.2f}x the no-cache engine")
+    return _emit(
+        f"paged-KV shared-prefix storm ({R} reqs x {shared_len}-tok "
+        f"system prompt)", speedup, "x", BARS["kv_prefix"],
+        {"effective_prefill_tokens_per_sec": round(warm_eff, 1),
+         "no_cache_prefill_tokens_per_sec": round(cold_eff, 1),
+         "prefix_hits": kv["prefix_hits"],
+         "prefix_tokens_saved": kv["prefix_tokens_saved"],
+         "cow_copies": kv["cow_copies"],
+         "outputs_bitwise_equal": True})
+
+
 def bench_quantized(streams=16, gen_tokens=96, fast=False):
     """Quantized-serving row: the SAME engines at f32 / int8 / fp8
     (docs/QUANTIZATION.md). Two halves:
@@ -1997,6 +2166,8 @@ BENCHES = {
     "serving": bench_serving,
     "ladder": bench_ladder,
     "decode": bench_decode,
+    "kv_storm": bench_kv_storm,
+    "kv_prefix": bench_kv_prefix,
     "quantized": bench_quantized,
     "router": bench_router,
     "observability": bench_observability,
@@ -2021,7 +2192,8 @@ _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "resnet50": 150, "lenet": 90, "vgg16": 90, "input_pipeline": 120,
         "parallelwrapper": 150, "sharded": 150, "word2vec": 120,
         "serving": 120, "ladder": 90, "quantized": 150,
-        "decode": 150, "observability": 100, "robustness": 100,
+        "decode": 150, "kv_storm": 120, "kv_prefix": 120,
+        "observability": 100, "robustness": 100,
         "router": 150, "online": 120, "train_perf": 150}
 
 

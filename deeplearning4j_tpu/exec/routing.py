@@ -309,15 +309,24 @@ def flash_attn_route(bh: int, t: int, dh: int, causal: bool,
 
 
 def decode_attn_route(c: Optional[int] = None, dh: Optional[int] = None,
-                      backend: Optional[str] = None) -> str:
+                      backend: Optional[str] = None,
+                      paged: bool = False) -> str:
     """Route the attention decode step: 'pallas' (flash decode-step
     kernel, ops/flash_decode.py) or 'scan' (the dense reference step —
     the path the bitwise-parity decode tests pin on CPU).
 
+    ``paged=True`` asks for the block-table-gather variant
+    (``flash_decode_step_paged``): same decision surface — the one
+    ``decode_attn`` pin and ``DL4JTPU_DECODE_ATTN_ROUTE`` env apply to
+    both, so a rollback or test pin flips the dense and paged engines
+    together ('scan' means gather-then-dense-math there, the parity
+    oracle).
+
     Default is pallas wherever the kernel supports the shape: the step
     is HBM-bound on the KV cache and the kernel stops reading at the
     cache position, so it wins by construction once the cache is larger
-    than one block (the caller screens ``supported(c, dh)`` first)."""
+    than one block (the caller screens ``supported(c, dh)`` /
+    ``supported_paged(block_size, dh)`` first)."""
     forced = _forced.get("decode_attn")
     if forced is not None:
         return forced

@@ -932,25 +932,33 @@ class ComputationGraph:
         self._rnn_carries = None
 
     # --------------------------------------------------- incremental decode
-    def init_decode_state(self, batch: int, max_len: int = 256):
+    def init_decode_state(self, batch: int, max_len: int = 256, kv=None):
         """Decode state keyed by layer-node name (see
         MultiLayerNetwork.init_decode_state; serving/decode.py holds this
-        tree resident on device across token steps)."""
+        tree resident on device across token steps). ``kv`` switches
+        attention nodes to the shared block-pool layout (serving/kv/)."""
         gc = self.conf.global_conf
         dt = _dtype_of(gc.compute_dtype or gc.dtype)
         out = {}
         for name in self.conf.topological_order:
             node = self.conf.nodes[name]
             if node.kind == "layer":
-                out[name] = node.layer.init_decode_state(
-                    self.params.get(name, {}), batch, max_len, dt)
+                if kv is not None:
+                    out[name] = node.layer.init_paged_decode_state(
+                        self.params.get(name, {}), batch, max_len,
+                        kv["num_blocks"], kv["block_size"], dt)
+                else:
+                    out[name] = node.layer.init_decode_state(
+                        self.params.get(name, {}), batch, max_len, dt)
         return out
 
-    def decode_step(self, params, state, dstate, x_t, pos):
+    def decode_step(self, params, state, dstate, x_t, pos,
+                    block_tables=None):
         """Pure one-token step along the topo order (single-input,
         single-path graphs; vertices like residual adds work on the
         (B, 1, F) slices unchanged). Bitwise contract and compute-dtype
-        handling match MultiLayerNetwork.decode_step."""
+        handling match MultiLayerNetwork.decode_step; ``block_tables``
+        routes attention nodes through the paged-KV path."""
         if len(self.conf.network_inputs) != 1:
             raise ValueError(
                 "incremental decode supports single-input graphs; got "
@@ -970,9 +978,48 @@ class ComputationGraph:
             if node.kind == "vertex":
                 acts[name] = node.vertex.apply(ins)
                 continue
-            y, nd = node.layer.decode_step(
-                params.get(name, {}), dstate.get(name), ins[0], pos,
-                state=state.get(name) if state else None)
+            st = state.get(name) if state else None
+            if block_tables is None:
+                y, nd = node.layer.decode_step(
+                    params.get(name, {}), dstate.get(name), ins[0], pos,
+                    state=st)
+            else:
+                y, nd = node.layer.decode_step_paged(
+                    params.get(name, {}), dstate.get(name), ins[0], pos,
+                    block_tables, state=st)
+            new_d[name] = nd
+            acts[name] = y
+        outs = [acts[n] for n in self.conf.network_outputs]
+        return (outs[0] if len(outs) == 1 else outs), new_d
+
+    def prefill_chunk(self, params, state, dstate, x, start, n,
+                      block_tables=None):
+        """Advance a prefill chunk along the topo order: ``x`` (B, K, F)
+        chunk activations, ``n`` (B,) valid rows (Layer.prefill_chunk).
+        Vertices apply to the (B, K, F) chunk slices unchanged."""
+        if len(self.conf.network_inputs) != 1:
+            raise ValueError(
+                "incremental decode supports single-input graphs; got "
+                f"inputs {self.conf.network_inputs}")
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            cdt = _dtype_of(gc.compute_dtype)
+            x = x.astype(cdt)
+            params = _cast_floats(params, cdt)
+        acts = {self.conf.network_inputs[0]: x}
+        new_d = dict(dstate)
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if node.kind == "input":
+                continue
+            ins = [acts[i] for i in node.inputs]
+            if node.kind == "vertex":
+                acts[name] = node.vertex.apply(ins)
+                continue
+            y, nd = node.layer.prefill_chunk(
+                params.get(name, {}), dstate.get(name), ins[0], start, n,
+                state=state.get(name) if state else None,
+                block_tables=block_tables)
             new_d[name] = nd
             acts[name] = y
         outs = [acts[n] for n in self.conf.network_outputs]

@@ -895,22 +895,33 @@ class MultiLayerNetwork:
         self._rnn_carries = None
 
     # --------------------------------------------------- incremental decode
-    def init_decode_state(self, batch: int, max_len: int = 256):
+    def init_decode_state(self, batch: int, max_len: int = 256, kv=None):
         """Per-layer decode state for ``batch`` concurrent streams of up to
         ``max_len`` tokens (serving/decode.py keeps this tree resident on
         device). Recurrent layers contribute their (h, c) carry; attention
-        a fixed-capacity KV cache; stateless layers None."""
+        a fixed-capacity KV cache; stateless layers None. ``kv`` — a
+        ``{"num_blocks": N, "block_size": bs}`` dict — switches attention
+        to the shared block-pool layout (serving/kv/) instead of dense
+        per-slot strips."""
         gc = self.conf.global_conf
         dt = _dtype_of(gc.compute_dtype or gc.dtype)
+        if kv is not None:
+            return [l.init_paged_decode_state(p, batch, max_len,
+                                              kv["num_blocks"],
+                                              kv["block_size"], dt)
+                    for l, p in zip(self.layers, self.params)]
         return [l.init_decode_state(p, batch, max_len, dt)
                 for l, p in zip(self.layers, self.params)]
 
-    def decode_step(self, params, state, dstate, x_t, pos):
+    def decode_step(self, params, state, dstate, x_t, pos,
+                    block_tables=None):
         """Pure one-token step through the stack: ``x_t`` (B, 1, F) input
         slice, ``pos`` (B,) int32 per-stream position. Returns
         ``(y, new_dstate)`` — bitwise-equal to position ``pos`` of a full
         teacher-forced ``_forward`` on the same prefix (the compute-dtype
-        cast mirrors ``_forward`` exactly so bf16 nets stay bit-identical)."""
+        cast mirrors ``_forward`` exactly so bf16 nets stay bit-identical).
+        ``block_tables`` (B, max_blocks) routes attention through the
+        paged-KV path; the dense path is byte-identical without it."""
         gc = self.conf.global_conf
         if gc.compute_dtype:
             cdt = _dtype_of(gc.compute_dtype)
@@ -919,8 +930,32 @@ class MultiLayerNetwork:
         x = x_t
         new_d = list(dstate)
         for i, l in enumerate(self.layers):
-            x, new_d[i] = l.decode_step(params[i], dstate[i], x, pos,
-                                        state=state[i] if state else None)
+            st = state[i] if state else None
+            if block_tables is None:
+                x, new_d[i] = l.decode_step(params[i], dstate[i], x, pos,
+                                            state=st)
+            else:
+                x, new_d[i] = l.decode_step_paged(params[i], dstate[i], x,
+                                                  pos, block_tables,
+                                                  state=st)
+        return x, new_d
+
+    def prefill_chunk(self, params, state, dstate, x, start, n,
+                      block_tables=None):
+        """Advance a prefill chunk through the stack: ``x`` (B, K, F)
+        activations for positions ``start .. start+K-1`` per stream, ``n``
+        (B,) valid rows (see Layer.prefill_chunk). Same compute-dtype
+        handling as ``decode_step``."""
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            cdt = _dtype_of(gc.compute_dtype)
+            x = x.astype(cdt)
+            params = _cast_floats(params, cdt)
+        new_d = list(dstate)
+        for i, l in enumerate(self.layers):
+            x, new_d[i] = l.prefill_chunk(params[i], dstate[i], x, start, n,
+                                          state=state[i] if state else None,
+                                          block_tables=block_tables)
         return x, new_d
 
     # ------------------------------------------------------------- evaluate

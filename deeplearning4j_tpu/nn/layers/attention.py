@@ -144,6 +144,37 @@ class MultiHeadAttention(Layer):
         return {"k": jnp.zeros((batch, max_len, H, Dh), dtype),
                 "v": jnp.zeros((batch, max_len, H, Dh), dtype)}
 
+    def _finish_step(self, params, q, kc, vc, pos):
+        """Shared decode-step attention math over a gathered/dense cache
+        ``kc``/``vc`` (B, C, H, Dh) — the ONE copy of the parity-oracle
+        path, so the paged gather stays byte-identical to the dense slot
+        step by construction."""
+        B = q.shape[0]
+        C = kc.shape[1]
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale     # (B, H, 1, C)
+        valid = jnp.arange(C)[None, :] <= pos[:, None]       # (B, C)
+        s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        # Bitwise parity trick: XLA:CPU lowers the q-length-1 contraction as
+        # a gemv whose accumulation order differs from the full forward's
+        # gemm rows in the last ulp. Broadcasting the single query row to 2
+        # rows forces the gemm path (rows are independent, so row 0 equals
+        # the teacher-forced row exactly); the duplicate row is one extra
+        # (C, Dh) dot per head — noise next to the step's dispatch cost.
+        p2 = jnp.broadcast_to(p, (B, p.shape[1], 2, C))
+        o = jnp.einsum("bhqk,bkhd->bqhd", p2, vc)[:, :1]
+        o = o.reshape(B, 1, self.n_out) @ params["Wo"]
+        if self.has_bias:
+            o = o + params["bo"]
+        return o
+
+    def _project_out(self, params, o, B, T, dt):
+        o = o.reshape(B, T, self.n_out).astype(dt) @ params["Wo"]
+        if self.has_bias:
+            o = o + params["bo"]
+        return o
+
     def decode_step(self, params, dstate, x, pos, state=None):
         if not self.causal:
             raise ValueError(
@@ -168,30 +199,112 @@ class MultiHeadAttention(Layer):
                     and decode_attn_route(C, Dh, backend=backend)
                     == "pallas"):
                 # flash decode-step: reads only pos+1 of the C cached rows
-                dt = q.dtype
                 o = ops.flash_decode_step(q[:, 0], kc, vc, pos,
                                           interpret=ops.interpret_mode())
-                o = o.reshape(B, 1, self.n_out).astype(dt) @ params["Wo"]
-                if self.has_bias:
-                    o = o + params["bo"]
-                return o, {"k": kc, "v": vc}
+                return (self._project_out(params, o, B, 1, q.dtype),
+                        {"k": kc, "v": vc})
+        return self._finish_step(params, q, kc, vc, pos), {"k": kc, "v": vc}
+
+    # ---- paged decode (serving/kv/) --------------------------------------
+    def init_paged_decode_state(self, params, batch, max_len, num_blocks,
+                                block_size, dtype=jnp.float32):
+        """KV block pool (kv/pool.py layout): (num_blocks, block_size, H,
+        Dh) per tensor, shared by every slot and addressed through the
+        engine's page tables. Keys 'pk'/'pv' (kv.POOL_KEYS) mark the
+        leaves the engine's per-slot wipe/freeze masks must skip."""
+        H = self.n_heads
+        Dh = (self.n_out or self.n_in) // H
+        return {"pk": jnp.zeros((num_blocks, block_size, H, Dh), dtype),
+                "pv": jnp.zeros((num_blocks, block_size, H, Dh), dtype)}
+
+    def decode_step_paged(self, params, dstate, x, pos, block_tables,
+                          state=None):
+        """Decode step against the block pool: scatter this position's KV
+        into its ``pos → (block, offset)`` pool row, then either run the
+        paged flash kernel (table-indexed DMA inside the kernel loop) or
+        gather the logical cache and run the byte-identical dense math —
+        the parity oracle the bitwise tests pin. Inactive slots carry
+        all-zero tables, so their writes land in the reserved scratch
+        block; the softmax position mask keeps scratch rows out of every
+        real slot's attention."""
+        if not self.causal:
+            raise ValueError(
+                "only causal attention can decode incrementally (non-causal "
+                "heads attend to future tokens)")
+        B = x.shape[0]
+        q, k, v = self._project(params, x)              # (B, 1, H, Dh)
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+        bs = dstate["pk"].shape[1]
+        MB = block_tables.shape[1]
+        rows = jnp.arange(B)
+        phys = block_tables[rows, pos // bs]            # (B,) pool block
+        off = pos % bs
+        pk = dstate["pk"].at[phys, off].set(k[:, 0])
+        pv = dstate["pv"].at[phys, off].set(v[:, 0])
+        C = MB * bs
+        from deeplearning4j_tpu import ops
+        if ops.helpers_enabled():
+            from deeplearning4j_tpu.exec import decode_attn_route
+            from deeplearning4j_tpu.ops import flash_decode
+            Dh = q.shape[-1]
+            backend = None if ops.interpret_mode() else jax.default_backend()
+            if (flash_decode.supported_paged(bs, Dh)
+                    and decode_attn_route(C, Dh, backend=backend,
+                                          paged=True) == "pallas"):
+                o = ops.flash_decode_step_paged(
+                    q[:, 0], pk, pv, pos, block_tables,
+                    interpret=ops.interpret_mode())
+                return (self._project_out(params, o, B, 1, q.dtype),
+                        {"pk": pk, "pv": pv})
+        kc = pk[block_tables].reshape(B, C, *pk.shape[2:])
+        vc = pv[block_tables].reshape(B, C, *pv.shape[2:])
+        return (self._finish_step(params, q, kc, vc, pos),
+                {"pk": pk, "pv": pv})
+
+    def prefill_chunk(self, params, dstate, x, start, n, state=None,
+                      block_tables=None):
+        """Chunked prefill against the block pool: scatter the chunk's K
+        rows of KV into their pool positions, gather the logical cache,
+        and run the same causal-masked softmax/gemm the full forward runs
+        — bitwise-equal to teacher forcing row-for-row (the (K, C) gemm's
+        rows are independent, like the decode trick's 2-row gemm). Rows
+        past a slot's ``n`` scatter into the scratch block and produce
+        garbage activations the engine discards."""
+        if dstate is None or "pk" not in dstate:
+            return super().prefill_chunk(params, dstate, x, start, n,
+                                         state=state,
+                                         block_tables=block_tables)
+        B, K, _ = x.shape
+        q, k, v = self._project(params, x)              # (B, K, H, Dh)
+        bs = dstate["pk"].shape[1]
+        MB = block_tables.shape[1]
+        C = MB * bs
+        poss = start[:, None] + jnp.arange(K)[None, :]  # (B, K) positions
+        valid = jnp.arange(K)[None, :] < n[:, None]
+        rows = jnp.arange(B)
+        bidx = jnp.clip(poss // bs, 0, MB - 1)
+        phys = jnp.where(valid, block_tables[rows[:, None], bidx], 0)
+        off = poss % bs
+        pk = dstate["pk"].at[phys, off].set(k)
+        pv = dstate["pv"].at[phys, off].set(v)
+        # gather AFTER the scatter: chunk rows attend causally to rows
+        # written in this same chunk, exactly like teacher forcing
+        kc = pk[block_tables].reshape(B, C, *pk.shape[2:])
+        vc = pv[block_tables].reshape(B, C, *pv.shape[2:])
         scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale     # (B, H, 1, C)
-        valid = jnp.arange(C)[None, :] <= pos[:, None]       # (B, C)
-        s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale   # (B, H, K, C)
+        causal = jnp.arange(C)[None, None, :] <= poss[:, :, None]
+        s = jnp.where(causal[:, None, :, :], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
-        # Bitwise parity trick: XLA:CPU lowers the q-length-1 contraction as
-        # a gemv whose accumulation order differs from the full forward's
-        # gemm rows in the last ulp. Broadcasting the single query row to 2
-        # rows forces the gemm path (rows are independent, so row 0 equals
-        # the teacher-forced row exactly); the duplicate row is one extra
-        # (C, Dh) dot per head — noise next to the step's dispatch cost.
-        p2 = jnp.broadcast_to(p, (B, p.shape[1], 2, C))
-        o = jnp.einsum("bhqk,bkhd->bqhd", p2, vc)[:, :1]
-        o = o.reshape(B, 1, self.n_out) @ params["Wo"]
+        if K == 1:   # single-row chunk: same gemv hazard as the decode step
+            p = jnp.broadcast_to(p, (B, p.shape[1], 2, C))
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, vc)[:, :1]
+        else:
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, vc)
+        o = o.reshape(B, K, self.n_out) @ params["Wo"]
         if self.has_bias:
             o = o + params["bo"]
-        return o, {"k": kc, "v": vc}
+        return o, {"pk": pk, "pv": pv}
 
 
 @register_layer
@@ -248,3 +361,12 @@ class PositionalEmbedding(Layer):
         B = x.shape[0]
         pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
         return x + params["P"][pos][:, None, :], dstate
+
+    def prefill_chunk(self, params, dstate, x, start, n, state=None,
+                      block_tables=None):
+        """Chunk rows sit at global positions ``start + t``, not ``t`` —
+        the stateless default's ``apply`` would add P[0:K]."""
+        K = x.shape[1]
+        poss = start[:, None] + jnp.arange(K)[None, :]   # (B, K)
+        poss = jnp.clip(poss, 0, self.max_len - 1)
+        return x + params["P"][poss], dstate

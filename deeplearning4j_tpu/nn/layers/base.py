@@ -112,6 +112,59 @@ class Layer:
         y, _ = self.apply(params, x, state, train=False, rng=None)
         return y, dstate
 
+    # ---- paged decode protocol (serving/kv/) -----------------------------
+    # The paged engine stores attention KV in a shared block pool indexed
+    # by per-slot page tables instead of per-slot dense strips. Layers
+    # WITHOUT a KV cache keep per-slot state exactly as in the dense
+    # protocol, so the defaults delegate; MultiHeadAttention overrides all
+    # three (pool-shaped state, table-gather step, chunk prefill).
+    def init_paged_decode_state(self, params, batch: int, max_len: int,
+                                num_blocks: int, block_size: int,
+                                dtype=jnp.float32):
+        """Decode state under paged KV: attention returns pool arrays
+        ((num_blocks, block_size, H, Dh) — keys in kv.POOL_KEYS); every
+        other layer returns its dense per-slot state unchanged."""
+        return self.init_decode_state(params, batch, max_len, dtype)
+
+    def decode_step_paged(self, params, dstate, x, pos, block_tables,
+                          state=None):
+        """``decode_step`` with a (B, max_blocks) int32 page table mapping
+        each stream's logical blocks to pool blocks. Layers without a KV
+        cache ignore the table."""
+        return self.decode_step(params, dstate, x, pos, state=state)
+
+    def prefill_chunk(self, params, dstate, x, start, n, state=None,
+                      block_tables=None):
+        """Advance a chunk of prefill positions in one call. ``x``:
+        (B, K, F) activations for positions ``start .. start+K-1`` per
+        stream; ``n``: (B,) int32 valid rows (rows t >= n[b] are padding —
+        their state writes are masked and their outputs garbage the caller
+        discards). Returns ``(y, new_dstate)`` with y (B, K, F_out).
+
+        Default: stateless layers apply() the whole chunk (timestep-wise
+        ops make this the full-forward math); stateful layers advance
+        their carry by scanning ``decode_step`` with a per-row valid mask
+        — bitwise the same trajectory a token-at-a-time prefill walks."""
+        if dstate is None:
+            y, _ = self.apply(params, x, state, train=False, rng=None)
+            return y, dstate
+        B, K = x.shape[0], x.shape[1]
+        xs = jnp.moveaxis(x, 1, 0)[:, :, None, :]       # (K, B, 1, F)
+
+        def step(d, xt_t):
+            xt, t = xt_t
+            y, nd = self.decode_step(params, d, xt, start + t, state=state)
+            v = t < n                                   # (B,) row validity
+
+            def keep(a, b):
+                return jnp.where(v.reshape((B,) + (1,) * (a.ndim - 1)), a, b)
+
+            nd = jax.tree_util.tree_map(keep, nd, d)
+            return nd, y
+
+        d, ys = jax.lax.scan(step, dstate, (xs, jnp.arange(K)))
+        return jnp.moveaxis(ys[:, :, 0, :], 0, 1), d
+
     def has_params(self) -> bool:
         return True
 

@@ -60,10 +60,11 @@ def interpret_mode() -> bool:
 from deeplearning4j_tpu.ops.lstm_pallas import (fused_lstm_sequence,  # noqa: E402
                                                 fused_lstm2_sequence)
 from deeplearning4j_tpu.ops.flash_attention import flash_attention  # noqa: E402
-from deeplearning4j_tpu.ops.flash_decode import flash_decode_step  # noqa: E402
+from deeplearning4j_tpu.ops.flash_decode import (flash_decode_step,  # noqa: E402
+                                                 flash_decode_step_paged)
 
 __all__ = [
     "helpers_enabled", "set_helpers_enabled", "interpret_mode",
     "fused_lstm_sequence", "fused_lstm2_sequence", "flash_attention",
-    "flash_decode_step",
+    "flash_decode_step", "flash_decode_step_paged",
 ]

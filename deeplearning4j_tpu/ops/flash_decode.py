@@ -51,6 +51,14 @@ def supported(c, dh):
             and 2 * c * dh * 4 <= 8 * 1024 * 1024)
 
 
+def supported_paged(block_size, dh):
+    """Shape screen for the paged kernel: the KV block is the DMA unit,
+    so it must meet the f32 tile floor on its own; the double-buffered
+    (block_size, Dh) staging pair must fit VMEM comfortably."""
+    return (block_size % 8 == 0 and dh % 8 == 0
+            and 2 * block_size * dh * 4 <= 4 * 1024 * 1024)
+
+
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, blk, c_total,
                    scale):
     p = pos_ref[0, 0]                           # this row's cache position
@@ -121,3 +129,86 @@ def flash_decode_step(q, kc, vc, pos, *, interpret=False):
         interpret=interpret,
     )(posf, qf, kf, vf)
     return o[:, 0, :].reshape(B, H, Dh)
+
+
+def _paged_kernel(bt_ref, pos_ref, q_ref, kp_ref, vp_ref, o_ref,
+                  kb_ref, vb_ref, sem_k, sem_v, *, bs, scale):
+    """One grid program per (batch row, head). The pools stay in ``ANY``
+    memory (HBM); the page table rides in SMEM and steers a manual DMA
+    per LIVE block — pos → (block, offset) indexing inside the fori_loop,
+    so only ``pos // bs + 1`` physical blocks are ever pulled to VMEM no
+    matter how fragmented the pool or how large the capacity."""
+    h = pl.program_id(1)
+    p = pos_ref[0]                              # this row's cache position
+    q = q_ref[0, 0]                             # (_QROWS, Dh) replicated
+
+    def body(j, carry):
+        m, l, acc = carry
+        phys = bt_ref[0, j]                     # logical block j -> pool
+        ck = pltpu.make_async_copy(kp_ref.at[phys, :, h, :], kb_ref, sem_k)
+        cv = pltpu.make_async_copy(vp_ref.at[phys, :, h, :], vb_ref, sem_v)
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+        kb = kb_ref[...]                        # (bs, Dh)
+        vb = vb_ref[...]
+        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        kpos = j * bs + lax.broadcasted_iota(jnp.int32, (_QROWS, bs), 1)
+        s = jnp.where(kpos <= p, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        pexp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + pexp.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(pexp, vb,
+                                    preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((_QROWS, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((_QROWS, 1), jnp.float32)
+    a0 = jnp.zeros((_QROWS, q.shape[-1]), jnp.float32)
+    upper = p // bs + 1                 # live blocks only — the paged
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, a0))   # flash win
+    o_ref[0, 0] = acc / l
+
+
+def flash_decode_step_paged(q, pk, pv, pos, block_tables, *,
+                            interpret=False):
+    """Paged decode step: attention over a block-pool KV cache.
+
+    ``q``: (B, H, Dh) query at the current position; ``pk``/``pv``:
+    (num_blocks, block_size, H, Dh) pool arrays with position ``pos``
+    already scattered in; ``block_tables``: (B, max_blocks) int32 page
+    tables; ``pos``: (B,) int32. Returns (B, H, Dh) f32 — bitwise role
+    identical to ``flash_decode_step`` on the gathered dense cache."""
+    B, H, Dh = q.shape
+    bs = pk.shape[1]
+    MB = block_tables.shape[1]
+    scale = 1.0 / (Dh ** 0.5)
+    qf = jnp.broadcast_to(q.astype(jnp.float32)[:, :, None, :],
+                          (B, H, _QROWS, Dh))
+    kern = functools.partial(_paged_kernel, bs=bs, scale=scale)
+    o = pl.pallas_call(
+        kern,
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((1, MB), lambda b, h: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), lambda b, h: (b,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, _QROWS, Dh), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, _QROWS, Dh),
+                               lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, _QROWS, Dh), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bs, Dh), jnp.float32),
+                        pltpu.VMEM((bs, Dh), jnp.float32),
+                        pltpu.SemaphoreType.DMA,
+                        pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32), jnp.asarray(pos, jnp.int32),
+      qf, pk.astype(jnp.float32), pv.astype(jnp.float32))
+    return o[:, :, 0, :]

@@ -52,13 +52,17 @@ from deeplearning4j_tpu.quant import (dequantize_tree, record_weight_bytes,
                                       resolve_precision, tree_bytes)
 from deeplearning4j_tpu.serving.engine import (_tree_signature,
                                                _validate_sig, validate_swap)
+from deeplearning4j_tpu.serving.kv import (BlockPool, PoolExhaustedError,
+                                           PrefixCache, blocks_for_span,
+                                           map_pool_leaves, map_slot_leaves)
 
 
 class _Request:
     """Host-side bookkeeping for one occupied slot."""
 
     __slots__ = ("prompt", "max_new", "seed", "temperature", "top_k",
-                 "cursor", "generated", "future", "fresh", "t_start")
+                 "cursor", "generated", "future", "fresh", "t_start",
+                 "kv_blocks")
 
     def __init__(self, prompt, max_new, seed, temperature, top_k, future):
         self.prompt = list(prompt)
@@ -71,6 +75,7 @@ class _Request:
         self.future = future
         self.fresh = True        # first step must wipe the slot's state
         self.t_start = time.perf_counter()
+        self.kv_blocks: List[int] = []   # paged engines: claimed pool blocks
 
 
 class DecodeEngine:
@@ -90,18 +95,54 @@ class DecodeEngine:
     ``eos_id``: token id that finishes a stream early (None = length only).
     ``max_queue``: bound on waiting requests (beyond it: overload error,
     HTTP 429 through the server).
+    ``kv``: ``"dense"`` (per-slot contiguous caches, the default) or
+    ``"paged"`` (device-resident block pool + per-slot page tables —
+    docs/DECODING.md "Paged KV cache"). Paged engines accept
+    ``kv_block_size`` (tokens per block), ``kv_blocks`` (pool size; default
+    sizes the pool for full occupancy), ``prefix_cache`` (reuse completed
+    prefill blocks across requests sharing a prompt prefix; requires a
+    model with no recurrent per-slot decode state) and ``chunk_tokens``
+    (split prefill into chunks of this many tokens that ride the batched
+    iteration cadence next to live decode slots, instead of occupying one
+    decode step per prompt token).
     """
 
     _ids = itertools.count()
 
     def __init__(self, model, slots: int = 8, max_len: int = 256,
                  eos_id: Optional[int] = None, max_queue: int = 256,
-                 precision: Optional[str] = None):
+                 precision: Optional[str] = None, kv: str = "dense",
+                 kv_block_size: int = 16, kv_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 chunk_tokens: Optional[int] = None):
         self.model = model
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.eos_id = eos_id
         self.max_queue = int(max_queue)
+        if kv not in ("dense", "paged"):
+            raise ValueError(f"kv must be 'dense' or 'paged', got {kv!r}")
+        if kv == "dense" and chunk_tokens is not None:
+            raise ValueError("chunk_tokens requires kv='paged'")
+        if kv == "paged" and self.max_len % int(kv_block_size) != 0:
+            # the gathered paged cache must cover exactly max_len positions
+            # for bitwise parity with the dense step program
+            raise ValueError(
+                f"max_len ({max_len}) must be a multiple of kv_block_size "
+                f"({kv_block_size})")
+        if chunk_tokens is not None and int(chunk_tokens) < 1:
+            raise ValueError("chunk_tokens must be >= 1")
+        self.kv = kv
+        self.kv_block_size = int(kv_block_size)
+        self.chunk_tokens = (int(chunk_tokens) if chunk_tokens is not None
+                             else None)
+        self.kv_max_blocks = (self.max_len // self.kv_block_size
+                              if kv == "paged" else 0)
+        self._pool: Optional[BlockPool] = None
+        self._prefix: Optional[PrefixCache] = None
+        self._tables: Optional[np.ndarray] = None
+        self._pending_cows: List[tuple] = []
+        self._kv_blocked = False
         self._is_graph = hasattr(model.conf, "network_inputs")
         itype = (model.conf.input_types[0] if self._is_graph
                  else model.conf.input_type)
@@ -117,12 +158,38 @@ class DecodeEngine:
         self.precision = (resolve_precision(precision)
                           if precision is not None else execu.precision)
         self._raw_sig = None
-        self._step = execu.jit(
-            self._step_impl,
-            in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS, ex.BATCH, ex.BATCH,
-                      ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH),
-            out_specs=(ex.BATCH, ex.SLOTS),
-            donate_argnums=(2,))
+        if self.kv == "paged":
+            # same step program shape every call: the (S, max_blocks) page
+            # table rides in as one more (S,)-leading data argument
+            self._step = execu.jit(
+                self._step_impl_paged,
+                in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS, ex.BATCH, ex.BATCH,
+                          ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH,
+                          ex.BATCH),
+                out_specs=(ex.BATCH, ex.SLOTS),
+                donate_argnums=(2,))
+        else:
+            self._step = execu.jit(
+                self._step_impl,
+                in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS, ex.BATCH, ex.BATCH,
+                          ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH, ex.BATCH),
+                out_specs=(ex.BATCH, ex.SLOTS),
+                donate_argnums=(2,))
+        self._prefill = None
+        self._cow = None
+        if self.chunk_tokens is not None:
+            self._prefill = execu.jit(
+                self._prefill_impl,
+                in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS, ex.BATCH, ex.BATCH,
+                          ex.BATCH, ex.BATCH, ex.BATCH),
+                out_specs=(ex.SLOTS,),
+                donate_argnums=(2,))
+        if self.kv == "paged" and prefix_cache:
+            self._cow = execu.jit(
+                self._cow_impl,
+                in_specs=(ex.SLOTS, ex.REPL, ex.REPL),
+                out_specs=(ex.SLOTS,),
+                donate_argnums=(0,))
         self._dstate = None
         self._live = None          # (params, state) after the first swap
         if self.precision != "f32":
@@ -178,6 +245,67 @@ class DecodeEngine:
         if self.precision != "f32":
             record_weight_bytes(self.id, self.precision,
                                 tree_bytes(self._live[0]))
+
+        if self.kv == "paged":
+            if kv_blocks is None:
+                # full occupancy by default: every slot can hold max_len
+                # tokens, +1 for the reserved scratch block
+                kv_blocks = self.slots * self.kv_max_blocks + 1
+            self._pool = BlockPool(int(kv_blocks), self.kv_block_size,
+                                   engine=self.id)
+            self._tables = np.zeros((self.slots, self.kv_max_blocks),
+                                    np.int32)
+            if prefix_cache:
+                # prefix reuse assumes a slot's KV blocks are the ONLY
+                # per-slot decode state — recurrent carries (LSTM h/c)
+                # depend on every earlier token and cannot be shared.
+                probe = self.model.init_decode_state(
+                    1, self.max_len,
+                    kv={"num_blocks": 2, "block_size": self.kv_block_size})
+                from deeplearning4j_tpu.serving.kv import is_pool_path
+                carries = []
+                jax.tree_util.tree_map_with_path(
+                    lambda p, a: carries.append(p)
+                    if not is_pool_path(p) else None, probe)
+                if carries:
+                    raise ValueError(
+                        "prefix_cache=True requires a model whose only "
+                        "per-slot decode state is the paged KV cache; this "
+                        "model carries recurrent state "
+                        f"({len(carries)} non-pool leaves). Pass "
+                        "prefix_cache=False.")
+                self._prefix = PrefixCache(self._pool)
+            self._m_kv_programs = reg.counter(
+                "dl4jtpu_kv_compiled_programs_total",
+                "XLA programs traced for the paged-KV side programs "
+                "(chunked prefill + copy-on-write; design target: at most "
+                "one each).", ("engine",)).labels(**lab)
+            self._m_kv_exhausted = reg.counter(
+                "dl4jtpu_kv_pool_exhausted_total",
+                "Admissions stalled because the KV block pool could not "
+                "cover the request at the queue head.",
+                ("engine",)).labels(**lab)
+            self._m_prefix_hits = reg.counter(
+                "dl4jtpu_kv_prefix_hits_total",
+                "Requests that reused at least one cached prefix block.",
+                ("engine",)).labels(**lab)
+            self._m_prefix_saved = reg.counter(
+                "dl4jtpu_kv_prefix_tokens_saved_total",
+                "Prefill positions skipped by prefix-cache reuse.",
+                ("engine",)).labels(**lab)
+            self._m_cow = reg.counter(
+                "dl4jtpu_kv_cow_copies_total",
+                "Copy-on-write block copies (partial prefix match claimed "
+                "then diverged into a private block).",
+                ("engine",)).labels(**lab)
+            self._m_prefill_chunks = reg.counter(
+                "dl4jtpu_kv_prefill_chunks_total",
+                "Chunked-prefill slot-chunks executed.",
+                ("engine",)).labels(**lab)
+            self._m_prefill_tokens = reg.counter(
+                "dl4jtpu_kv_prefill_tokens_total",
+                "Prompt tokens prefilled through the chunked-prefill "
+                "program.", ("engine",)).labels(**lab)
 
     @property
     def trace_count(self) -> int:
@@ -244,6 +372,10 @@ class DecodeEngine:
         params, state, version, applied = self._pending_swap
         self._pending_swap = None
         self._live = (params, state)
+        if self._prefix is not None:
+            # cached KV was computed under the OLD weights — reusing it
+            # across a swap would splice two model versions into one stream
+            self._prefix.clear()
         self._version = (int(version) if version is not None
                          else self._version + 1)
         self._m_version.set(float(self._version))
@@ -259,12 +391,33 @@ class DecodeEngine:
             return (self.slots > 0
                     and all(r is not None for r in self._slot_reqs))
 
+    @property
+    def kv_exhausted(self) -> bool:
+        """Paged engines: the request at the queue head could not claim
+        blocks at the last admission pass (clears as blocks release).
+        /healthz reports ``degraded`` with the pool occupancy."""
+        if self._pool is None:
+            return False
+        with self._cv:
+            return self._kv_blocked
+
+    def kv_pool_info(self) -> Optional[dict]:
+        """Pool occupancy snapshot for /healthz and stats (None = dense)."""
+        if self._pool is None:
+            return None
+        return {"blocks": self._pool.usable,
+                "blocks_free": self._pool.free_count,
+                "blocks_in_use": self._pool.in_use,
+                "blocks_cached": self._pool.cached_count,
+                "block_size": self.kv_block_size}
+
     # ------------------------------------------------------------- the step
     def _step_impl(self, params, state, dstate, tokens, pos, reset, active,
-                   seeds, temps, topk):
+                   seeds, temps, topk, btab=None):
         """ONE iteration for all S slots. All arguments are (S,)-shaped, so
         every call shares a single XLA program; scheduling decisions ride in
-        as data (masks), never as shapes."""
+        as data (masks), never as shapes. ``btab`` (paged engines) is the
+        (S, max_blocks) page table — also data, same program shape."""
         from deeplearning4j_tpu.exec.programs import is_registering
         if not is_registering():
             self._m_compiled.inc()   # traced-only: exact compiled-program count
@@ -279,10 +432,17 @@ class DecodeEngine:
             return jnp.where(r, jnp.zeros_like(a), a)
 
         # re-claimed slots start from zero state INSIDE the step — claiming
-        # a slot never needs a second program, and stale carries can't leak
-        dstate = jax.tree_util.tree_map(wipe, dstate)
+        # a slot never needs a second program, and stale carries can't leak.
+        # Paged engines never wipe the pool: blocks are recycled by the
+        # host-side refcounts, and a reset slot's table points at fresh ones.
+        tmap = (jax.tree_util.tree_map if btab is None else map_slot_leaves)
+        dstate = tmap(wipe, dstate)
         x = jax.nn.one_hot(tokens, self.vocab, dtype=jnp.float32)[:, None, :]
-        y, new_d = self.model.decode_step(params, state, dstate, x, pos)
+        if btab is None:
+            y, new_d = self.model.decode_step(params, state, dstate, x, pos)
+        else:
+            y, new_d = self.model.decode_step(params, state, dstate, x, pos,
+                                              block_tables=btab)
 
         probs = y[:, 0, :]
         logits = jnp.log(probs)      # output layer emits probs; log is
@@ -308,14 +468,68 @@ class DecodeEngine:
             return jnp.where(a, new, old)
 
         # inactive slots keep their state bit-identical (numerically inert)
-        new_d = jax.tree_util.tree_map(freeze, new_d, dstate)
+        new_d = tmap(freeze, new_d, dstate)
         return next_tok, new_d
+
+    def _step_impl_paged(self, params, state, dstate, btab, tokens, pos,
+                         reset, active, seeds, temps, topk):
+        """Paged step: the page table is a positional arg (donation-friendly
+        ordering: state right after params/state, (S,)-data after)."""
+        return self._step_impl(params, state, dstate, tokens, pos, reset,
+                               active, seeds, temps, topk, btab=btab)
+
+    def _prefill_impl(self, params, state, dstate, btab, tokens, start, n,
+                      reset):
+        """Chunked prefill for all S slots in ONE call: slot i consumes
+        ``n[i]`` prompt tokens ``tokens[i, :n[i]]`` at positions
+        ``start[i]..start[i]+n[i]-1``. ``n == 0`` rows are inert: their KV
+        writes land in the scratch block (all-zero table rows) and their
+        state rows are frozen. One fixed (S, chunk_tokens) shape → one XLA
+        program regardless of how many slots are mid-prefill."""
+        from deeplearning4j_tpu.exec.programs import is_registering
+        if not is_registering():
+            self._m_kv_programs.inc()
+        params = dequantize_tree(params)
+        S = self.slots
+
+        def wipe(a):
+            r = reset.reshape((S,) + (1,) * (a.ndim - 1))
+            return jnp.where(r, jnp.zeros_like(a), a)
+
+        # a fresh slot's FIRST device call may be a prefill chunk, so the
+        # reset wipe lives here too (same rule as the step)
+        dstate = map_slot_leaves(wipe, dstate)
+        x = jax.nn.one_hot(tokens, self.vocab, dtype=jnp.float32)
+        _, new_d = self.model.prefill_chunk(params, state, dstate, x, start,
+                                            n, block_tables=btab)
+        live = n > 0
+
+        def freeze(new, old):
+            a = live.reshape((S,) + (1,) * (new.ndim - 1))
+            return jnp.where(a, new, old)
+
+        return map_slot_leaves(freeze, new_d, dstate)
+
+    def _cow_impl(self, dstate, src, dst):
+        """Copy-on-write: clone pool block ``src`` into ``dst`` (both (1,)
+        int32) across every pool leaf. Runs when a request claims a
+        partially-matching cached prefix block and will overwrite its tail."""
+        from deeplearning4j_tpu.exec.programs import is_registering
+        if not is_registering():
+            self._m_kv_programs.inc()
+        return map_pool_leaves(lambda a: a.at[dst].set(a[src]), dstate)
 
     # ------------------------------------------------------------ lifecycle
     def _ensure_dstate(self):
         if self._dstate is None:
-            self._dstate = self.model.init_decode_state(self.slots,
-                                                        self.max_len)
+            if self.kv == "paged":
+                self._dstate = self.model.init_decode_state(
+                    self.slots, self.max_len,
+                    kv={"num_blocks": self._pool.num_blocks,
+                        "block_size": self.kv_block_size})
+            else:
+                self._dstate = self.model.init_decode_state(self.slots,
+                                                            self.max_len)
 
     def start(self) -> "DecodeEngine":
         self._ensure_dstate()
@@ -341,6 +555,18 @@ class DecodeEngine:
             self._queue.clear()
             live = [r for r in self._slot_reqs if r is not None]
             self._slot_reqs = [None] * self.slots
+            if self._pool is not None:
+                # aborted streams never publish prefix blocks (their KV is
+                # incomplete); everything they claimed goes back to the pool
+                for r in live:
+                    for b in r.kv_blocks:
+                        self._pool.decref(b)
+                    r.kv_blocks = []
+                for src, _dst in self._pending_cows:
+                    self._pool.decref(src)   # dst was freed via r.kv_blocks
+                self._pending_cows = []
+                self._tables[:] = 0
+                self._kv_blocked = False
         for r in pending + live:
             if not r.future.done():
                 r.future.set_exception(err)
@@ -360,15 +586,28 @@ class DecodeEngine:
         t0 = time.perf_counter()
         params, state = self._weights()
         c0 = self._m_compiled.value
-        tok, self._dstate = self._step(
-            params, state, self._dstate, z, z, f, f,
-            np.zeros(S, np.uint32), np.zeros(S, np.float32), z)
+        step_args = (z, z, f, f, np.zeros(S, np.uint32),
+                     np.zeros(S, np.float32), z)
+        if self.kv == "paged":
+            step_args = (np.zeros((S, self.kv_max_blocks), np.int32),
+                         ) + step_args
+        tok, self._dstate = self._step(params, state, self._dstate,
+                                       *step_args)
         jax.block_until_ready(tok)
+        # the paged side programs compile here too — a no-op chunk (every
+        # n == 0) and a scratch self-copy leave the state bitwise intact
+        if self._prefill is not None:
+            self._dstate = self._prefill(
+                params, state, self._dstate,
+                np.zeros((S, self.kv_max_blocks), np.int32),
+                np.zeros((S, self.chunk_tokens), np.int32), z, z, f)
+        if self._cow is not None:
+            self._dstate = self._cow(self._dstate, np.zeros(1, np.int32),
+                                     np.zeros(1, np.int32))
+        jax.block_until_ready(self._dstate)
         self.warmup_seconds = time.perf_counter() - t0
         if self._m_compiled.value > c0:
-            self._register_program(params, state,
-                                   (z, z, f, f, np.zeros(S, np.uint32),
-                                    np.zeros(S, np.float32), z),
+            self._register_program(params, state, step_args,
                                    self.warmup_seconds)
         return self.warmup_seconds
 
@@ -397,6 +636,14 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens})"
                 f" exceeds engine capacity max_len={self.max_len}")
+        if self._pool is not None:
+            need = blocks_for_span(len(prompt) + int(max_new_tokens) - 1,
+                                   self.kv_block_size)
+            if need > self._pool.usable:
+                raise ValueError(
+                    f"request needs {need} KV blocks "
+                    f"(block_size={self.kv_block_size}) but the pool holds "
+                    f"{self._pool.usable} — it could never be admitted")
         if self._stop.is_set() and self._thread is not None:
             raise BatcherStoppedError("decode engine stopped")
         fut = Future()
@@ -419,11 +666,80 @@ class DecodeEngine:
     def _admit_locked(self):
         if self._pending_swap is not None:
             return          # admission pauses so live slots can drain
+        blocked = False
         for i in range(self.slots):
             if not self._queue:
                 break
-            if self._slot_reqs[i] is None:
-                self._slot_reqs[i] = self._queue.popleft()
+            if self._slot_reqs[i] is not None:
+                continue
+            r = self._queue[0]
+            if self._pool is not None:
+                try:
+                    self._claim_kv(r, i)
+                except PoolExhaustedError:
+                    # head-of-line blocking is deliberate: the request at
+                    # the queue head admits as soon as blocks free up (no
+                    # starvation of long prompts by short ones)
+                    if not self._kv_blocked:
+                        self._m_kv_exhausted.inc()
+                    blocked = True
+                    break
+            self._queue.popleft()
+            self._slot_reqs[i] = r
+        if self._pool is not None:
+            self._kv_blocked = blocked
+
+    def _claim_kv(self, r, slot):
+        """Claim pool blocks + build the page-table row for one admitted
+        request (loop thread, under ``self._cv``). Prefix-cache hits claim
+        cached blocks read-only (refcount++) and skip their prefill span;
+        a partial tail match is claimed via copy-on-write. All-or-nothing:
+        on exhaustion every claimed ref is returned and the request stays
+        queued."""
+        bs = self.kv_block_size
+        plen = len(r.prompt)
+        # KV positions written: 0 .. plen + max_new - 2 (the final sampled
+        # token is returned, never fed back)
+        need = blocks_for_span(plen + r.max_new - 1, bs)
+        shared, cow, skip = [], None, 0
+        if self._prefix is not None:
+            shared, cow, skip = self._prefix.match(r.prompt)
+        try:
+            fresh = self._pool.alloc(need - len(shared))
+        except PoolExhaustedError:
+            for b in shared:
+                self._pool.decref(b)
+            if cow is not None:
+                self._pool.decref(cow[0])
+            raise
+        if cow is not None:
+            # clone the partially-matching cached block into our first
+            # fresh block; the copy program runs before this slot's first
+            # prefill/step, and the source ref is dropped after the copy
+            self._pending_cows.append((cow[0], fresh[0]))
+        if skip:
+            self._m_prefix_hits.inc()
+            self._m_prefix_saved.inc(skip)
+        r.kv_blocks = shared + fresh
+        r.cursor = skip                  # prefill resumes past the reuse
+        row = self._tables[slot]
+        row[:] = 0
+        row[:need] = r.kv_blocks
+
+    def _release_kv(self, slot, r):
+        """Return a finished request's blocks to the pool (loop thread).
+        Publication into the prefix cache happens FIRST so blocks whose
+        refcount drops to zero park in the evictable LRU instead of the
+        free list. This is the full-release path slot re-claim depends on:
+        occupancy returns to baseline once nothing references the blocks."""
+        if not r.kv_blocks:
+            return
+        if self._prefix is not None:
+            self._prefix.insert(r.prompt, r.kv_blocks)
+        for b in r.kv_blocks:
+            self._pool.decref(b)
+        r.kv_blocks = []
+        self._tables[slot][:] = 0
 
     def _loop(self):
         S = self.slots
@@ -439,6 +755,50 @@ class DecodeEngine:
                         if r is not None]
                 if not live:
                     self._cv.wait(timeout=0.05)
+                    continue
+            params, state = self._weights()
+            if self._pending_cows:
+                # copy-on-write claims run BEFORE the claimer's first
+                # prefill/step can read (or overwrite) the cloned block
+                cows, self._pending_cows = self._pending_cows, []
+                for src, dst in cows:
+                    self._dstate = self._cow(self._dstate,
+                                             np.full(1, src, np.int32),
+                                             np.full(1, dst, np.int32))
+                    self._pool.decref(src)
+                    self._m_cow.inc()
+            if self.chunk_tokens is not None:
+                # chunked prefill rides the same iteration cadence: slots
+                # still consuming their prompt advance by up to K positions
+                # per iteration while decode-phase slots step one token
+                pre = [(i, r) for i, r in live
+                       if r.cursor < len(r.prompt) - 1]
+                if pre:
+                    K = self.chunk_tokens
+                    ptok = np.zeros((S, K), np.int32)
+                    pstart = np.zeros(S, np.int32)
+                    pn = np.zeros(S, np.int32)
+                    preset = np.zeros(S, bool)
+                    for i, r in pre:
+                        k = min(K, len(r.prompt) - 1 - r.cursor)
+                        ptok[i, :k] = r.prompt[r.cursor:r.cursor + k]
+                        pstart[i] = r.cursor
+                        pn[i] = k
+                        preset[i] = r.fresh
+                        r.fresh = False
+                        r.cursor += k
+                    with trace.span("decode_prefill", chunks=len(pre)):
+                        self._dstate = self._prefill(
+                            params, state, self._dstate,
+                            jnp.asarray(self._tables), ptok, pstart, pn,
+                            preset)
+                    self._m_prefill_chunks.inc(len(pre))
+                    self._m_prefill_tokens.inc(int(pn.sum()))
+                # slots that finished their chunk this iteration join the
+                # step below (cursor is now at the last prompt position)
+                live = [(i, r) for i, r in live
+                        if r.cursor >= len(r.prompt) - 1]
+                if not live:
                     continue
             tokens = np.zeros(S, np.int32)
             pos = np.zeros(S, np.int32)
@@ -459,18 +819,21 @@ class DecodeEngine:
                 temps[i] = r.temperature
                 topk[i] = r.top_k
             t0 = time.perf_counter()
-            params, state = self._weights()
             c0 = self._m_compiled.value
+            step_args = (tokens, pos, reset, active, seeds, temps, topk)
+            if self._pool is not None:
+                # inactive slots get an all-zero table row so their masked
+                # write lands in the scratch block — a mid-prefill slot's
+                # REAL row here would let the step corrupt its block 0
+                btab = np.where(active[:, None], self._tables, 0)
+                step_args = (jnp.asarray(btab.astype(np.int32)),) + step_args
             with trace.span("decode_step", active=len(live)):
-                nt, self._dstate = self._step(
-                    params, state, self._dstate,
-                    tokens, pos, reset, active, seeds, temps, topk)
+                nt, self._dstate = self._step(params, state, self._dstate,
+                                              *step_args)
                 nt = np.asarray(nt)
             dt = time.perf_counter() - t0
             if self._m_compiled.value > c0:
-                self._register_program(
-                    params, state,
-                    (tokens, pos, reset, active, seeds, temps, topk), dt)
+                self._register_program(params, state, step_args, dt)
             self._decode_seconds += dt
             self._m_steps.inc()
             self._m_occupancy.set(len(live))
@@ -488,6 +851,11 @@ class DecodeEngine:
                         or r.cursor >= self.max_len):
                     done.append((i, r))
             for i, r in done:
+                if self._pool is not None:
+                    # full release on eos/length: every claimed block's
+                    # refcount returns to the pool (prefix-cached blocks
+                    # park in the evictable LRU, everything else frees)
+                    self._release_kv(i, r)
                 with self._cv:
                     self._slot_reqs[i] = None    # freed; wiped on re-claim
                 self._m_requests.inc()
@@ -501,7 +869,22 @@ class DecodeEngine:
             occupied = sum(r is not None for r in self._slot_reqs)
             queued = len(self._queue)
         toks = self._m_tokens.value
+        kv = None
+        if self._pool is not None:
+            kv = dict(self.kv_pool_info())
+            kv.update({
+                "prefix_cache": self._prefix is not None,
+                "chunk_tokens": self.chunk_tokens,
+                "kv_programs": int(self._m_kv_programs.value),
+                "prefix_hits": int(self._m_prefix_hits.value),
+                "prefix_tokens_saved": int(self._m_prefix_saved.value),
+                "cow_copies": int(self._m_cow.value),
+                "prefill_chunks": int(self._m_prefill_chunks.value),
+                "prefill_tokens": int(self._m_prefill_tokens.value),
+                "exhausted_events": int(self._m_kv_exhausted.value),
+            })
         return {"id": self.id,
+                "kv": kv,
                 "slots": self.slots,
                 "max_len": self.max_len,
                 "precision": self.precision,

@@ -1,0 +1,36 @@
+"""Paged KV-cache subsystem for the continuous-batching decode engine.
+
+Three parts, one execution model:
+
+- ``pool``    — device-resident pool of fixed-size KV blocks + host-side
+  refcounted allocator with LRU eviction; per-slot page tables of fixed
+  width keep the step program's shape constant (block 0 is the scratch
+  block that absorbs masked writes).
+- ``prefix``  — completed prefill blocks published under rolling
+  prompt-token-hash chains; later requests claim shared spans read-only
+  (refcount++) with copy-on-write at the first divergent block.
+- ``prefill`` — chunked-prefill planning: long prompts ride the
+  iteration-granularity batched cadence ``chunk_tokens`` at a time next
+  to live decode slots.
+
+Wiring lives in serving/decode.py (``DecodeEngine(kv="paged", ...)``);
+the attention layers' paged step/gather paths are in
+nn/layers/attention.py and ops/flash_decode.py. See docs/DECODING.md
+("Paged KV") for tuning knobs and the correctness bar.
+"""
+
+from deeplearning4j_tpu.serving.kv.pool import (BlockPool,  # noqa: F401
+                                                PoolExhaustedError,
+                                                SCRATCH_BLOCK, POOL_KEYS,
+                                                is_pool_path,
+                                                map_slot_leaves,
+                                                map_pool_leaves)
+from deeplearning4j_tpu.serving.kv.prefix import PrefixCache  # noqa: F401
+from deeplearning4j_tpu.serving.kv.prefill import (plan_chunks,  # noqa: F401
+                                                   blocks_for_span)
+
+__all__ = [
+    "BlockPool", "PoolExhaustedError", "SCRATCH_BLOCK", "POOL_KEYS",
+    "is_pool_path", "map_slot_leaves", "map_pool_leaves",
+    "PrefixCache", "plan_chunks", "blocks_for_span",
+]

@@ -1,0 +1,209 @@
+"""Device-resident KV block pool: allocation, refcounts, eviction.
+
+The dense DecodeEngine reserves ``max_len`` KV rows per slot for the whole
+lifetime of a request — a 16-token completion in a 2048-capacity slot
+wastes >99% of its cache, and two requests sharing a system prompt store
+it twice. PagedAttention (Kwon et al., SOSP'23) replaces the per-slot
+strip with a pool of fixed-size blocks plus a per-slot page table: the
+attention layers store KV in ``(num_blocks, block_size, H, Dh)`` pool
+arrays that live INSIDE the engine's donated decode-state tree, and every
+step gathers a slot's logical cache ``kc = pool[table[slot]]`` before
+running the byte-identical dense math (the parity oracle) or the paged
+flash kernel (ops/flash_decode.py).
+
+This module is the HOST side: which physical block backs which logical
+block of which request. It is single-threaded by design — only the
+engine's scheduler loop allocates/frees — so the bookkeeping is plain
+lists, no locks. Three block states:
+
+- free       — on the free list, content garbage
+- referenced — refcount ≥ 1 holder (a live slot, or a pending
+               copy-on-write source)
+- cached     — refcount 0 but content is a prefix-cache entry
+               (kv/prefix.py); LRU-evictable, revived by a later hit
+
+Block 0 is RESERVED as the scratch block: inactive slots' page tables are
+all-zero, so the step program's masked writes for inactive/invalid rows
+land in block 0 and never corrupt live data — scheduling stays data, the
+program shape never changes.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, List, Optional
+
+from deeplearning4j_tpu.monitor import get_registry
+
+SCRATCH_BLOCK = 0
+
+# decode-state dict keys that hold pool arrays ((num_blocks, block_size,
+# H, Dh) — shared across slots) rather than per-slot state. The engine's
+# wipe/reset and freeze/active masks are (S,)-shaped and must never touch
+# these leaves; block ownership is what isolates slots instead.
+POOL_KEYS = ("pk", "pv")
+
+
+def is_pool_path(path) -> bool:
+    """True when a tree path addresses a pool leaf (a dict key in
+    ``POOL_KEYS`` anywhere along the path)."""
+    return any(getattr(e, "key", None) in POOL_KEYS for e in path)
+
+
+def map_slot_leaves(fn, tree, *rest):
+    """``tree_map(fn, tree, *rest)`` over per-slot leaves only; pool
+    leaves pass through from ``tree`` untouched."""
+    import jax
+    return jax.tree_util.tree_map_with_path(
+        lambda p, a, *r: a if is_pool_path(p) else fn(a, *r), tree, *rest)
+
+
+def map_pool_leaves(fn, tree):
+    """``tree_map(fn, tree)`` over pool leaves only; per-slot leaves pass
+    through untouched (the engine's copy-on-write program)."""
+    import jax
+    return jax.tree_util.tree_map_with_path(
+        lambda p, a: fn(a) if is_pool_path(p) else a, tree)
+
+
+class PoolExhaustedError(Exception):
+    """No free or evictable block: admission must wait for a release."""
+
+
+class BlockPool:
+    """Refcounted allocator over ``num_blocks`` physical KV blocks of
+    ``block_size`` token positions each (block 0 reserved as scratch).
+
+    ``alloc`` is all-or-nothing: it evicts LRU cached blocks as needed and
+    raises ``PoolExhaustedError`` without side effects when the request
+    cannot be satisfied — the engine leaves the request queued and
+    /healthz reports ``kv_pool_exhausted``.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 engine: str = "kv"):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks={num_blocks}: need at least 2 (block 0 is the "
+                f"reserved scratch block)")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size} must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._ref = [0] * self.num_blocks
+        self._ref[SCRATCH_BLOCK] = 1          # pinned forever
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._evictable: "OrderedDict[int, bool]" = OrderedDict()  # LRU
+        self._cached = set()                  # bids holding cache content
+        # prefix-cache hook: called with the bid as its entry is dropped
+        self.on_evict: Optional[Callable[[int], None]] = None
+
+        reg = get_registry()
+        lab = {"engine": engine}
+        self._m_blocks = reg.gauge(
+            "dl4jtpu_kv_pool_blocks",
+            "Usable KV blocks in the pool (capacity minus the reserved "
+            "scratch block).", ("engine",)).labels(**lab)
+        self._m_free = reg.gauge(
+            "dl4jtpu_kv_pool_blocks_free",
+            "KV blocks allocatable right now (free list plus evictable "
+            "prefix-cache blocks).", ("engine",)).labels(**lab)
+        self._m_evictions = reg.counter(
+            "dl4jtpu_kv_pool_evictions_total",
+            "Prefix-cache blocks evicted (LRU) to satisfy an allocation.",
+            ("engine",)).labels(**lab)
+        self._m_blocks.set(float(self.usable))
+        self._m_free.set(float(self.free_count))
+
+    # ------------------------------------------------------------- accounting
+    @property
+    def usable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        """Blocks allocatable without waiting (free + evictable)."""
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def in_use(self) -> int:
+        """Blocks with a live reference (scratch excluded) — the leak
+        test's occupancy measure."""
+        return sum(1 for b in range(1, self.num_blocks) if self._ref[b] > 0)
+
+    @property
+    def cached_count(self) -> int:
+        return len(self._cached)
+
+    def refcount(self, bid: int) -> int:
+        return self._ref[bid]
+
+    def is_cached(self, bid: int) -> bool:
+        return bid in self._cached
+
+    # ------------------------------------------------------------ allocation
+    def alloc(self, n: int) -> List[int]:
+        """Claim ``n`` blocks at refcount 1, evicting LRU cached blocks if
+        the free list runs short. All-or-nothing."""
+        if n > self.free_count:
+            raise PoolExhaustedError(
+                f"need {n} blocks, {self.free_count} allocatable "
+                f"({len(self._free)} free + {len(self._evictable)} "
+                f"evictable)")
+        out = []
+        for _ in range(n):
+            if not self._free:
+                self._evict_one()
+            bid = self._free.pop()
+            self._ref[bid] = 1
+            out.append(bid)
+        self._m_free.set(float(self.free_count))
+        return out
+
+    def incref(self, bid: int) -> None:
+        if bid == SCRATCH_BLOCK:
+            raise ValueError("scratch block cannot be claimed")
+        if self._ref[bid] == 0:
+            # reviving a cached (evictable) block: a prefix hit
+            if bid not in self._evictable:
+                raise ValueError(f"block {bid} is free; alloc() it instead")
+            del self._evictable[bid]
+        self._ref[bid] += 1
+        self._m_free.set(float(self.free_count))
+
+    def decref(self, bid: int) -> None:
+        if bid == SCRATCH_BLOCK:
+            raise ValueError("scratch block is never released")
+        if self._ref[bid] <= 0:
+            raise ValueError(f"block {bid} already free")
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            if bid in self._cached:
+                self._evictable[bid] = True   # LRU tail: newest entry
+            else:
+                self._free.append(bid)
+        self._m_free.set(float(self.free_count))
+
+    # ---------------------------------------------------------- prefix cache
+    def mark_cached(self, bid: int) -> None:
+        """Flag a block's content as a prefix-cache entry: when its last
+        reference drops it becomes LRU-evictable instead of free."""
+        self._cached.add(bid)
+
+    def _evict_one(self) -> None:
+        bid, _ = self._evictable.popitem(last=False)   # LRU head
+        self._cached.discard(bid)
+        if self.on_evict is not None:
+            self.on_evict(bid)
+        self._free.append(bid)
+        self._m_evictions.inc()
+
+    def flush_cached(self) -> int:
+        """Drop every ref-0 cache entry (weight swaps: cached KV was
+        computed under the old weights). Returns blocks freed."""
+        n = 0
+        while self._evictable:
+            self._evict_one()
+            n += 1
+        self._m_free.set(float(self.free_count))
+        return n

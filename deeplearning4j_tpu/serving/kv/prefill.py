@@ -1,0 +1,45 @@
+"""Chunked prefill planning (Sarathi-Serve, Agrawal et al., OSDI'24).
+
+A long prompt prefilled one token per batched step occupies its slot for
+``len(prompt)`` iterations; prefilled in one full-length forward it would
+stall every co-tenant decode slot for the whole prompt. The middle road:
+the engine compiles ONE extra ``(S, chunk_tokens)``-shaped prefill
+program and feeds each prefilling slot up to ``chunk_tokens`` prompt
+positions per iteration, in the same iteration-granularity cadence as
+the decode step — a 4k-token prefix never stalls live decode slots past
+one chunk, and a slot's decode latency budget bounds the collateral.
+
+The chunk attention math is bitwise-equal to teacher forcing: a chunk
+scatters its K rows into the paged cache, gathers the full logical
+cache, and runs the same causal-masked softmax/gemm the full forward
+runs — per-row gemm equality holds on XLA:CPU exactly as it does for
+the 2-row decode trick (docs/DECODING.md). Rows past a slot's ``n`` are
+masked: their KV writes land in the reserved scratch block and their
+activations are discarded, so a short tail chunk reuses the same
+program shape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def plan_chunks(start: int, end: int, chunk_tokens: int
+                ) -> List[Tuple[int, int]]:
+    """Split prefill positions ``[start, end)`` into ``(start, n)`` chunks
+    of at most ``chunk_tokens`` — the per-iteration feed schedule for one
+    slot. Empty when the span is empty."""
+    if chunk_tokens < 1:
+        raise ValueError(f"chunk_tokens={chunk_tokens} must be >= 1")
+    out = []
+    p = int(start)
+    while p < end:
+        n = min(chunk_tokens, end - p)
+        out.append((p, n))
+        p += n
+    return out
+
+
+def blocks_for_span(span: int, block_size: int) -> int:
+    """Physical blocks needed to hold KV for positions ``[0, span)``."""
+    return -(-int(span) // int(block_size))

@@ -1,0 +1,151 @@
+"""Prefix cache: completed prefill blocks keyed by rolling token-hash
+chains, shared read-only with copy-on-write at the first divergent block.
+
+Fan-out traffic (one system prompt, many continuations) pays prefill once:
+when a request completes, every pool block whose ``block_size`` positions
+hold only PROMPT tokens is published under the rolling hash of the token
+chain from position 0 to its end. A later request walks the same chain —
+full block by full block — and claims each hit read-only (refcount++);
+prefill is skipped for the shared span. Because a block's key commits the
+ENTIRE prefix up to it (not just its own tokens), a chain hit guarantees
+positional KV equality: the cached rows are bitwise what this request's
+own prefill would have written under the same weights.
+
+Where the chain breaks, a cached sibling block may still share a partial
+run of tokens; that block is claimed by COPY-on-write — the engine copies
+it into a freshly allocated block on device and the request overwrites
+from the first divergent position — so a 63/64-token near-miss still
+skips most of a block's prefill without ever mutating shared content.
+
+Single-threaded like the pool: only the engine's scheduler calls in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.serving.kv.pool import BlockPool
+
+_ROOT = b"kv-prefix-root"
+
+
+def _chain_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(b"|")
+    h.update(b",".join(str(int(t)).encode() for t in tokens))
+    return h.digest()
+
+
+class PrefixCache:
+    """Rolling-hash-chain index over cached pool blocks.
+
+    ``match`` walks a prompt's full blocks along the chain, claiming every
+    hit (incref — revives evictable blocks), and returns the first
+    divergent block's best partial candidate for CoW. ``insert`` publishes
+    a finished request's full prompt blocks. Eviction from the pool calls
+    back into ``_drop`` so the index never points at a recycled block.
+    """
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        self._by_hash: Dict[bytes, int] = {}        # chain hash -> bid
+        self._by_bid: Dict[int, bytes] = {}
+        # parent hash -> [(bid, tokens)]: partial-match candidates for the
+        # block after a matched chain (copy-on-write sources)
+        self._children: Dict[bytes, List[Tuple[int, Tuple[int, ...]]]] = {}
+        self._child_of: Dict[int, bytes] = {}
+        pool.on_evict = self._drop
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    # ---------------------------------------------------------------- lookup
+    def match(self, prompt: Sequence[int]
+              ) -> Tuple[List[int], Optional[Tuple[int, int]], int]:
+        """Claim the longest cached chain for ``prompt``.
+
+        Returns ``(shared, cow, skip)``: ``shared`` — claimed (incref'd)
+        block ids covering positions ``[0, len(shared)*block_size)``;
+        ``cow`` — ``(src_bid, n_match)`` partial candidate for the next
+        block (src is incref'd to pin it until the engine's device copy
+        runs) or None; ``skip`` — prompt positions whose prefill is
+        skipped. Capped at ``len(prompt) - 1``: the final prompt token
+        must run through a real step to produce the first output.
+        """
+        bs = self.pool.block_size
+        plen = len(prompt)
+        limit = (plen - 1) // bs        # full blocks claimable read-only
+        shared: List[int] = []
+        h = _ROOT
+        for j in range(limit):
+            nxt = _chain_hash(h, prompt[j * bs:(j + 1) * bs])
+            bid = self._by_hash.get(nxt)
+            if bid is None:
+                break
+            self.pool.incref(bid)
+            shared.append(bid)
+            h = nxt
+        skip = len(shared) * bs
+        # partial tail: a cached child of the matched chain sharing the
+        # first tokens of the next block → copy-on-write candidate
+        cow: Optional[Tuple[int, int]] = None
+        want = prompt[skip:min(plen - 1, skip + bs)]
+        if want:
+            best = 0
+            for bid, toks in self._children.get(h, ()):
+                n = 0
+                for a, b in zip(want, toks):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best:
+                    best, cow = n, (bid, n)
+            if cow is not None:
+                self.pool.incref(cow[0])
+        return shared, cow, skip + (cow[1] if cow else 0)
+
+    # --------------------------------------------------------------- publish
+    def insert(self, prompt: Sequence[int], blocks: Sequence[int]) -> int:
+        """Publish a finished request's full PROMPT blocks (block ``j`` is
+        cacheable iff positions ``[j*bs, (j+1)*bs)`` are all prompt
+        tokens). First writer wins: a chain hash already published keeps
+        its existing block (the content is identical by construction).
+        Returns entries added."""
+        bs = self.pool.block_size
+        added = 0
+        h = _ROOT
+        for j in range(len(prompt) // bs):
+            nxt = _chain_hash(h, prompt[j * bs:(j + 1) * bs])
+            if nxt not in self._by_hash:
+                bid = blocks[j]
+                if bid in self._by_bid:      # bid already published under
+                    h = nxt                  # another chain — keep it
+                    continue
+                self._by_hash[nxt] = bid
+                self._by_bid[bid] = nxt
+                tok = tuple(int(t) for t in prompt[j * bs:(j + 1) * bs])
+                self._children.setdefault(h, []).append((bid, tok))
+                self._child_of[bid] = h
+                self.pool.mark_cached(bid)
+                added += 1
+            h = nxt
+        return added
+
+    # -------------------------------------------------------------- eviction
+    def _drop(self, bid: int) -> None:
+        """Pool eviction callback: forget every index entry for ``bid``."""
+        h = self._by_bid.pop(bid, None)
+        if h is not None:
+            self._by_hash.pop(h, None)
+        parent = self._child_of.pop(bid, None)
+        if parent is not None:
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids[:] = [(b, t) for b, t in kids if b != bid]
+                if not kids:
+                    del self._children[parent]
+
+    def clear(self) -> int:
+        """Flush every ref-0 entry through the pool (weight swaps)."""
+        return self.pool.flush_cached()

@@ -72,13 +72,21 @@ def build_model(name: str):
 def build_server(model_name: str = "charlstm", port: int = 0,
                  slots: int = 4, max_len: int = 64, max_queue: int = 256,
                  max_latency_ms: float = 2.0, chaos: bool = False,
-                 precision: Optional[str] = None):
+                 precision: Optional[str] = None, kv: str = "dense",
+                 kv_block_size: int = 16, kv_blocks: Optional[int] = None,
+                 prefix_cache: bool = False,
+                 chunk_tokens: Optional[int] = None):
     """Assemble (but don't start) a replica InferenceServer. ``charlstm``
     serves both /predict and /generate; ``mlp`` is predict-only.
     ``precision`` (None = the executor policy / DL4JTPU_PRECISION) puts
     BOTH engines on the low-precision serving path — boot-time
     ``--checkpoint`` swaps and later /admin/swap deploys arrive in f32
-    and quantize behind the validation gate (docs/QUANTIZATION.md)."""
+    and quantize behind the validation gate (docs/QUANTIZATION.md).
+    ``kv``/``kv_block_size``/``kv_blocks``/``prefix_cache``/
+    ``chunk_tokens`` select the paged KV cache for the decode engine
+    (docs/DECODING.md "Paged KV"); ``prefix_cache`` defaults off here
+    because the stock charlstm carries recurrent decode state, which the
+    prefix cache cannot share."""
     from deeplearning4j_tpu.serving.decode import DecodeEngine
     from deeplearning4j_tpu.serving.engine import InferenceEngine
     from deeplearning4j_tpu.serving.server import InferenceServer
@@ -87,7 +95,10 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     dec = None
     if model_name == "charlstm":
         dec = DecodeEngine(net, slots=slots, max_len=max_len,
-                           max_queue=max_queue, precision=precision)
+                           max_queue=max_queue, precision=precision,
+                           kv=kv, kv_block_size=kv_block_size,
+                           kv_blocks=kv_blocks, prefix_cache=prefix_cache,
+                           chunk_tokens=chunk_tokens)
     injector = None
     if chaos:
         from deeplearning4j_tpu.resilience.faults import ServerFaultInjector
@@ -126,6 +137,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="enable span tracing (also via DL4JTPU_TRACE); "
                              "the ring buffer is served at GET /trace for "
                              "fleet collection")
+    parser.add_argument("--kv", default="dense", choices=("dense", "paged"),
+                        help="decode KV layout: per-slot dense caches or "
+                             "the block-pool paged cache")
+    parser.add_argument("--kv-block-size", type=int, default=16,
+                        help="tokens per KV block (paged only; must divide "
+                             "--max-len)")
+    parser.add_argument("--kv-blocks", type=int, default=None,
+                        help="KV pool size in blocks (paged only; default "
+                             "sizes for full slot occupancy)")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        help="reuse completed prefill blocks across "
+                             "requests sharing a prompt prefix (paged only; "
+                             "needs a model with no recurrent decode state)")
+    parser.add_argument("--chunk-tokens", type=int, default=None,
+                        help="split prefill into chunks of this many tokens "
+                             "riding the batched decode cadence (paged only)")
     args = parser.parse_args(argv)
 
     # CPU platform before anything touches a backend: replicas are test
@@ -143,7 +170,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     srv = build_server(args.model, port=args.port, slots=args.slots,
                        max_len=args.max_len, max_queue=args.max_queue,
                        max_latency_ms=args.max_latency_ms, chaos=args.chaos,
-                       precision=args.precision)
+                       precision=args.precision, kv=args.kv,
+                       kv_block_size=args.kv_block_size,
+                       kv_blocks=args.kv_blocks,
+                       prefix_cache=args.prefix_cache,
+                       chunk_tokens=args.chunk_tokens)
     if srv.decode_engine is not None:
         srv.decode_engine.start()
         if args.warmup:
@@ -212,7 +243,10 @@ class ReplicaProcess:
                  slots: int = 4, max_len: int = 64,
                  chaos: bool = True, warmup: bool = True,
                  name: str = "replica", checkpoint: Optional[str] = None,
-                 precision: Optional[str] = None, trace: bool = False):
+                 precision: Optional[str] = None, trace: bool = False,
+                 kv: str = "dense", kv_block_size: int = 16,
+                 kv_blocks: Optional[int] = None, prefix_cache: bool = False,
+                 chunk_tokens: Optional[int] = None):
         self.workdir = workdir
         self.model = model
         self.slots = slots
@@ -221,6 +255,11 @@ class ReplicaProcess:
         self.warmup = warmup
         self.name = name
         self.precision = precision
+        self.kv = kv
+        self.kv_block_size = kv_block_size
+        self.kv_blocks = kv_blocks
+        self.prefix_cache = prefix_cache
+        self.chunk_tokens = chunk_tokens
         # span tracing in the child (GET /trace serves its ring buffer)
         self.trace = trace
         # mutable: rolling restarts set this to the latest promoted
@@ -253,6 +292,15 @@ class ReplicaProcess:
             cmd.extend(["--precision", self.precision])
         if self.trace:
             cmd.append("--trace")
+        if self.kv != "dense":
+            cmd.extend(["--kv", self.kv,
+                        "--kv-block-size", str(self.kv_block_size)])
+            if self.kv_blocks is not None:
+                cmd.extend(["--kv-blocks", str(self.kv_blocks)])
+            if self.prefix_cache:
+                cmd.append("--prefix-cache")
+            if self.chunk_tokens is not None:
+                cmd.extend(["--chunk-tokens", str(self.chunk_tokens)])
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = (_repo_root() + os.pathsep

@@ -456,15 +456,22 @@ class InferenceServer:
     def health_info(self) -> dict:
         """``{"status": ...}`` plus a ``reason`` when degraded. Degraded
         states a router acts on: ``queue_pressure`` (micro-batch queue ≥80%
-        full) and ``decode_saturated`` (every DecodeEngine slot busy — new
-        /generate work queues behind a full batch, so prefill-heavy traffic
-        should steer to replicas with free slots)."""
+        full), ``kv_pool_exhausted`` (a paged decode engine cannot claim KV
+        blocks for the request at its queue head — long-prompt work should
+        steer away until blocks free up) and ``decode_saturated`` (every
+        DecodeEngine slot busy — new /generate work queues behind a full
+        batch, so prefill-heavy traffic should steer to replicas with free
+        slots)."""
         if self._draining.is_set() or self.batcher.stopping:
             return {"status": "draining"}
         st = self.batcher.stats()
         if st["queue_capacity"] and (st["queue_depth"]
                                      >= 0.8 * st["queue_capacity"]):
             return {"status": "degraded", "reason": "queue_pressure"}
+        if (self.decode_engine is not None
+                and getattr(self.decode_engine, "kv_exhausted", False)):
+            return {"status": "degraded", "reason": "kv_pool_exhausted",
+                    "kv": self.decode_engine.kv_pool_info()}
         if self.decode_engine is not None and self.decode_engine.saturated:
             return {"status": "degraded", "reason": "decode_saturated"}
         if self.health_hook is not None:

@@ -42,6 +42,26 @@ def test_train_perf_row_fast():
     assert bf16["mfu"] is not None
 
 
+def test_kv_storm_row_fast():
+    row = bench.bench_kv_storm(fast=True)
+    # the function itself asserts dense/paged bitwise output parity, the
+    # one-step-program pin, ≤2 kv side programs, and full pool release
+    assert row["unit"] == "tokens/sec"
+    assert row["outputs_bitwise_equal"] is True
+    assert row["compiled_programs"] == [1, 1]
+    assert row["kv_programs"] <= 2
+    assert row["prefill_chunks"] > 0
+
+
+def test_kv_prefix_row_fast():
+    row = bench.bench_kv_prefix(fast=True)
+    assert row["unit"] == "x"
+    assert row["outputs_bitwise_equal"] is True
+    assert row["prefix_hits"] == 3                  # R-1 with fast R=4
+    assert row["prefix_tokens_saved"] >= 3 * 16
+    assert row["cow_copies"] == 0                   # boundary divergence
+
+
 def test_ladder_row_fast():
     row = bench.bench_ladder(fast=True)
     assert row["unit"] == "percent"
