@@ -1774,6 +1774,114 @@ def bench_observability(batch=128, blocks=24, passes=3):
             f"metrics={s_met} tracing={s_tr}")
     _emit_tracing_storm_row()
     _emit_program_mfu_row(batch=batch)
+    bench_train_telemetry(batch=batch, blocks=blocks, passes=max(2, passes - 1))
+    return out
+
+
+def bench_train_telemetry(batch=128, blocks=24, passes=3, fast=False):
+    """The observability row's train-telemetry column: the SAME LeNet-MNIST
+    streamed epoch timed with the flight recorder off / on at K=1 (every
+    step carries the in-trace (L, 5) side-output) / on at K=20 (the
+    sampled production cadence) — three fresh same-seed nets over the
+    SAME batch list, warmed then min-over-passes. Asserted in every mode:
+    final scores BITWISE identical across all three (the side-output
+    observes the step, never perturbs it), one compiled train program per
+    config (the traced sampling predicate keeps the program count
+    pinned), and recorded iterations exactly on the K-cadence. The <3%%
+    fit-overhead bar at K=20 is asserted in full mode only — CPU timing
+    of the CI variant (``fast=True``, tiny MLP on synthetic data) proves
+    nothing about the chip."""
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.monitor.flight import FlightRecorder
+    from deeplearning4j_tpu.util.timing import host_sync
+
+    if fast:
+        from deeplearning4j_tpu import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+        batch, blocks, passes = 16, 6, 1
+        rs = np.random.RandomState(3)
+        x = rs.randn(batch * blocks, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, batch * blocks)]
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().seed(42)
+                    .updater(Adam(1e-3)).weight_init("xavier").list()
+                    .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+                    .layer(OutputLayer(n_in=16, n_out=4,
+                                       activation="softmax", loss="mcxent"))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+        src = "synthetic"
+    else:
+        from __graft_entry__ import _lenet_conf
+        from deeplearning4j_tpu.data.fetchers import load_mnist, data_source
+        x, y = load_mnist(train=True, num_examples=batch * blocks,
+                          flatten=False)
+
+        def build():
+            return MultiLayerNetwork(_lenet_conf()).init()
+        src = data_source("mnist")
+    data = [DataSet(x[i * batch:(i + 1) * batch],
+                    y[i * batch:(i + 1) * batch]) for i in range(blocks)]
+
+    def measure(sample_every):
+        net = build()
+        rec = None
+        if sample_every:
+            rec = FlightRecorder(sample_every=sample_every, capacity=4096)
+            net.attach_flight_recorder(rec)
+        net.fit(data)                          # warm: compile + first epoch
+        host_sync(net._score)
+        best = float("inf")
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            net.fit(data)
+            host_sync(net._score)
+            best = min(best, time.perf_counter() - t0)
+        return best, float(net.get_score()), rec, net._compile_count
+
+    t_off, s_off, _, c_off = measure(0)
+    t_k1, s_k1, rec1, c_k1 = measure(1)
+    t_k20, s_k20, rec20, c_k20 = measure(20)
+    identical = (s_off == s_k1 == s_k20)
+    total_iters = blocks * (passes + 1)
+    its1 = [r["iteration"] for r in rec1.records()]
+    its20 = [r["iteration"] for r in rec20.records()]
+    cadence_ok = (bool(its20) and all(i % 20 == 0 for i in its20)
+                  and len(its1) == min(total_iters, rec1.capacity))
+    pct1 = max(0.0, (t_k1 - t_off) / t_off * 100.0)
+    pct20 = max(0.0, (t_k20 - t_off) / t_off * 100.0)
+    out = _emit(
+        "Observability overhead: train telemetry recorder on at K=20 "
+        f"({'mlp' if fast else 'LeNet'} fit epoch, batch={batch}, "
+        f"{blocks} blocks)", pct20, "percent", 3.0,
+        {"epoch_sec_off": round(t_off, 4),
+         "epoch_sec_k1": round(t_k1, 4),
+         "epoch_sec_k20": round(t_k20, 4),
+         "overhead_pct_k1": round(pct1, 1),
+         "bitwise_identical_score": identical,
+         "records_k1": len(its1), "records_k20": len(its20),
+         "cadence_ok": cadence_ok,
+         "compiled_programs": [c_off, c_k1, c_k20],
+         "data_source": src})
+    if not identical:
+        raise AssertionError(
+            f"flight recorder changed training: scores off={s_off} "
+            f"k1={s_k1} k20={s_k20}")
+    if not (c_off == c_k1 == c_k20):
+        raise AssertionError(
+            f"recorder changed the compiled program count: "
+            f"off={c_off} k1={c_k1} k20={c_k20}")
+    if not cadence_ok:
+        raise AssertionError(
+            f"sampling cadence violated: K=1 recorded {len(its1)}/"
+            f"{total_iters}, K=20 recorded iterations {its20}")
+    if not fast and pct20 >= 3.0:
+        raise AssertionError(
+            f"train-telemetry overhead at K=20 is {pct20:.1f}% "
+            "(acceptance ceiling: 3%)")
     return out
 
 
@@ -2193,7 +2301,7 @@ _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "parallelwrapper": 150, "sharded": 150, "word2vec": 120,
         "serving": 120, "ladder": 90, "quantized": 150,
         "decode": 150, "kv_storm": 120, "kv_prefix": 120,
-        "observability": 100, "robustness": 100,
+        "observability": 160, "robustness": 100,
         "router": 150, "online": 120, "train_perf": 150}
 
 

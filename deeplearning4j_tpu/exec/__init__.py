@@ -14,7 +14,8 @@ from deeplearning4j_tpu.exec.executor import (Executor,  # noqa: F401
                                               get_executor, set_executor,
                                               param_spec,
                                               PARAMS, STATE, OPT, REPL,
-                                              BATCH, STEP_BATCH, SLOTS)
+                                              BATCH, STEP_BATCH, SLOTS,
+                                              AUX)
 from deeplearning4j_tpu.exec.routing import (lstm_fwd_route,  # noqa: F401
                                              lstm_grad_route,
                                              flash_attn_route,
@@ -29,6 +30,7 @@ __all__ = [
     "set_default_mesh", "host_device_env",
     "Executor", "get_executor", "set_executor", "param_spec",
     "PARAMS", "STATE", "OPT", "REPL", "BATCH", "STEP_BATCH", "SLOTS",
+    "AUX",
     "lstm_fwd_route", "lstm_grad_route", "flash_attn_route",
     "decode_attn_route", "set_route",
     "load_measurements", "load_measurements_file",

@@ -45,6 +45,10 @@ REPL = "repl"              # replicate (scalars, loss)
 BATCH = "batch"            # shard dim 0 over 'data' (x, y, masks)
 STEP_BATCH = "step_batch"  # shard dim 1 over 'data' ((steps, batch, ...))
 SLOTS = "slots"            # decode state: dim 0 = slot rows, KV dims TP
+AUX = "aux"                # small replicated side-outputs (telemetry):
+                           # never donated, never sharded — a fused
+                           # (L, C) stats array rides the step program
+                           # without perturbing its main-output layout
 
 _ROW_TOKENS = ("Wo", "ff2", "down")
 _COL_TOKENS = ("Wq", "Wk", "Wv", "ff1", "up")

@@ -61,6 +61,7 @@ class ComputationGraph:
         self._fused = None            # fused update plan (nn/fused_update.py)
         self._update_step = None      # standalone donated update program
         self._compile_count = 0       # train programs traced (see _note_compile)
+        self._flight = None           # FlightRecorder (monitor/flight.py)
         self._train_mon = None        # lazy TrainMonitor (metric children)
         self._exec = None             # execution core (lazy; exec/executor.py)
         # per-instance caller id for the XLA program registry (/programs):
@@ -125,6 +126,18 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
+        return self
+
+    def attach_flight_recorder(self, recorder):
+        """Attach (or detach, with None) a ``monitor.flight.FlightRecorder``.
+        The train-step/fit_scan programs re-trace ONCE with the fused
+        ``(L, 5)`` telemetry side-output (see monitor/flight.py); detached
+        training stays byte-identical to today's path."""
+        self._flight = recorder
+        if recorder is not None:
+            recorder.bind(self)
+        self._train_step_cache = {}   # force re-trace with/without the
+        self._scan_fit = None         # side-output
         return self
 
     # ----------------------------------------------------------- forward core
@@ -327,6 +340,9 @@ class ComputationGraph:
 
     def _make_train_step(self):
         loss_fn = self._loss_for_grad()
+        rec = self._flight           # captured at trace-build time: the
+        # recorder-off program is byte-identical to the pre-flight path
+        sample_k = rec.sample_every if rec is not None else 1
 
         def step(params, state, opt_state, inputs, labels, it, masks, label_masks):
             self._note_compile()
@@ -336,14 +352,23 @@ class ComputationGraph:
                 loss_fn, has_aux=True)(params, state, inputs, labels, rng,
                                        masks, label_masks)
             new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
-            return new_params, new_state, new_opt, loss
+            if rec is None:
+                return new_params, new_state, new_opt, loss
+            from deeplearning4j_tpu.monitor import flight
+            telem = flight.step_telemetry(
+                flight.telemetry_triples(params, new_params, grads),
+                it, sample_k)
+            return new_params, new_state, new_opt, loss, telem
 
         from deeplearning4j_tpu import exec as ex
+        out_specs = (ex.PARAMS, ex.STATE, ex.OPT, ex.REPL)
+        if rec is not None:
+            out_specs = out_specs + (ex.AUX,)
         return self._executor.jit(
             step,
             in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.BATCH, ex.BATCH,
                       ex.REPL, ex.BATCH, ex.BATCH),
-            out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL),
+            out_specs=out_specs,
             donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------- fit
@@ -365,6 +390,8 @@ class ComputationGraph:
         labels_steps = [jnp.asarray(a) for a in labels_steps]
         if self._scan_fit is None:
             loss_fn = self._loss_for_grad()
+            rec = self._flight       # trace-build capture (see attach)
+            sample_k = rec.sample_every if rec is not None else 1
 
             def inner(params, state, opt_state, xs, ys, it0):
                 self._note_compile()
@@ -377,25 +404,45 @@ class ComputationGraph:
                     (loss, new_state), grads = jax.value_and_grad(
                         loss_fn, has_aux=True)(params, state, x, y, rng,
                                                None, None)
-                    params, opt_state = self._dp_apply_updates(
+                    new_params, opt_state = self._dp_apply_updates(
                         params, opt_state, grads)
-                    return (params, new_state, opt_state, it + 1), loss
+                    if rec is None:
+                        return (new_params, new_state, opt_state,
+                                it + 1), loss
+                    from deeplearning4j_tpu.monitor import flight
+                    telem = flight.step_telemetry(
+                        flight.telemetry_triples(params, new_params, grads),
+                        it, sample_k)
+                    return (new_params, new_state, opt_state, it + 1), \
+                        (loss, telem)
 
-                (p, s, o, _), losses = jax.lax.scan(
+                (p, s, o, _), out = jax.lax.scan(
                     body, (params, state, opt_state, it0), (xs, ys))
-                return p, s, o, losses
+                if rec is None:
+                    return p, s, o, out
+                return p, s, o, out[0], out[1]
 
             from deeplearning4j_tpu import exec as ex
+            out_specs = (ex.PARAMS, ex.STATE, ex.OPT, ex.REPL)
+            if rec is not None:
+                out_specs = out_specs + (ex.AUX,)
             self._scan_fit = self._executor.jit(
                 inner,
                 in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.STEP_BATCH,
                           ex.STEP_BATCH, ex.REPL),
-                out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL),
+                out_specs=out_specs,
                 donate_argnums=(0, 1, 2))
         c0, t0 = self._compile_count, time.perf_counter()
-        self.params, self.state, self.opt_state, losses = self._scan_fit(
-            self.params, self.state, self.opt_state, inputs_steps,
-            labels_steps, jnp.asarray(self.iteration, jnp.int32))
+        if self._flight is not None:
+            (self.params, self.state, self.opt_state, losses,
+             telems) = self._scan_fit(
+                self.params, self.state, self.opt_state, inputs_steps,
+                labels_steps, jnp.asarray(self.iteration, jnp.int32))
+            self._flight.record_scan(self.iteration, telems)
+        else:
+            self.params, self.state, self.opt_state, losses = self._scan_fit(
+                self.params, self.state, self.opt_state, inputs_steps,
+                labels_steps, jnp.asarray(self.iteration, jnp.int32))
         self._last_input = [a[-1] for a in inputs_steps]  # activation capture
         n_steps = int(inputs_steps[0].shape[0])
         self.iteration += n_steps
@@ -705,11 +752,14 @@ class ComputationGraph:
             if key not in self._train_step_cache:
                 self._train_step_cache[key] = self._make_train_step()
             step = self._train_step_cache[key]
-            self.params, self.state, self.opt_state, loss = step(
+            out = step(
                 self.params, self.state, self.opt_state, inputs, labels,
                 jnp.asarray(self.iteration, jnp.int32), masks, label_masks)
+            self.params, self.state, self.opt_state, loss = out[:4]
             self._score = loss  # device scalar; host-read deferred to
                                 # get_score() (sync ~100ms on tunneled TPUs)
+            if self._flight is not None:
+                self._flight.record(self.iteration, out[4])
             if self._compile_count > c0:
                 # fresh XLA program: expose its cost/memory analysis via the
                 # registry (/programs). Donated inputs → lower with outputs.
@@ -735,6 +785,9 @@ class ComputationGraph:
 
     # ---------------------------------------------------------------- tbptt
     def _make_tbptt_step(self):
+        rec = self._flight
+        sample_k = rec.sample_every if rec is not None else 1
+
         def step(params, state, opt_state, inputs, labels, it, masks,
                  label_masks, carries):
             self._note_compile()
@@ -745,14 +798,23 @@ class ComputationGraph:
                                           masks, label_masks, carries)
             new_params, new_opt = self._dp_apply_updates(params, opt_state,
                                                          grads)
-            return new_params, new_state, new_opt, loss, new_carries
+            if rec is None:
+                return new_params, new_state, new_opt, loss, new_carries
+            from deeplearning4j_tpu.monitor import flight
+            telem = flight.step_telemetry(
+                flight.telemetry_triples(params, new_params, grads),
+                it, sample_k)
+            return new_params, new_state, new_opt, loss, new_carries, telem
 
         from deeplearning4j_tpu import exec as ex
+        out_specs = (ex.PARAMS, ex.STATE, ex.OPT, ex.REPL, ex.BATCH)
+        if rec is not None:
+            out_specs = out_specs + (ex.AUX,)
         return self._executor.jit(
             step,
             in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.BATCH, ex.BATCH,
                       ex.REPL, ex.BATCH, ex.BATCH, ex.BATCH),
-            out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL, ex.BATCH),
+            out_specs=out_specs,
             donate_argnums=(0, 1, 2))
 
     def _fit_tbptt(self, inputs, labels, masks, label_masks):
@@ -769,6 +831,7 @@ class ComputationGraph:
         step = self._train_step_cache["tbptt"]
         carries = {}
         losses = []
+        telem = None
         for start in range(0, T, L):
             sl = slice(start, start + L)
             ins = [x[:, sl] if x.ndim == 3 else x for x in inputs]
@@ -778,11 +841,17 @@ class ComputationGraph:
             lms = None if label_masks is None else [
                 None if m is None else (m[:, sl] if m.ndim >= 2 else m)
                 for m in label_masks]
-            self.params, self.state, self.opt_state, loss, carries = step(
+            out = step(
                 self.params, self.state, self.opt_state, ins, lbs,
                 jnp.asarray(self.iteration, jnp.int32), mks, lms, carries)
+            self.params, self.state, self.opt_state, loss, carries = out[:5]
+            if self._flight is not None:
+                telem = out[5]      # every chunk shares the iteration —
+                                    # the LAST chunk's stats are the record
             losses.append(loss)
         self._score = jnp.mean(jnp.stack(losses))   # device-side mean
+        if self._flight is not None and telem is not None:
+            self._flight.record(self.iteration, telem)
 
     # ------------------------------------------------------------- inference
     def serving_engine(self, **kw):

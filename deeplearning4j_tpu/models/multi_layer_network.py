@@ -67,6 +67,7 @@ class MultiLayerNetwork:
         self._fused = None            # fused update plan (nn/fused_update.py)
         self._update_step = None      # standalone donated update program
         self._compile_count = 0       # train programs traced (see _note_compile)
+        self._flight = None           # FlightRecorder (monitor/flight.py)
         self._train_mon = None        # lazy TrainMonitor (metric children)
         self._exec = None             # execution core (lazy; exec/executor.py)
         # per-instance caller id for the XLA program registry (/programs):
@@ -130,6 +131,18 @@ class MultiLayerNetwork:
 
     def add_listeners(self, *listeners):
         self.listeners.extend(listeners)
+        return self
+
+    def attach_flight_recorder(self, recorder):
+        """Attach (or detach, with None) a ``monitor.flight.FlightRecorder``.
+        The train-step/fit_scan programs re-trace ONCE with the fused
+        ``(L, 5)`` telemetry side-output (see monitor/flight.py); detached
+        training stays byte-identical to today's path."""
+        self._flight = recorder
+        if recorder is not None:
+            recorder.bind(self)
+        self._train_step = None       # force re-trace with/without the
+        self._scan_fit = None         # side-output
         return self
 
     # ----------------------------------------------------------- forward core
@@ -341,6 +354,9 @@ class MultiLayerNetwork:
 
     def _make_train_step(self, with_masks, with_carries):
         loss_fn = self._loss_for_grad()
+        rec = self._flight           # captured at trace-build time: the
+        # recorder-off program is byte-identical to the pre-flight path
+        sample_k = rec.sample_every if rec is not None else 1
 
         def step(params, state, opt_state, x, y, it, mask_f, mask_l, carries):
             self._note_compile()
@@ -350,14 +366,23 @@ class MultiLayerNetwork:
                 loss_fn, has_aux=True)(params, state, x, y, rng,
                                        mask_f, mask_l, carries)
             new_params, new_opt = self._dp_apply_updates(params, opt_state, grads)
-            return new_params, new_state, new_opt, loss, new_carries
+            if rec is None:
+                return new_params, new_state, new_opt, loss, new_carries
+            from deeplearning4j_tpu.monitor import flight
+            telem = flight.step_telemetry(
+                flight.telemetry_triples(params, new_params, grads),
+                it, sample_k)
+            return new_params, new_state, new_opt, loss, new_carries, telem
 
         from deeplearning4j_tpu import exec as ex
+        out_specs = (ex.PARAMS, ex.STATE, ex.OPT, ex.REPL, ex.BATCH)
+        if rec is not None:
+            out_specs = out_specs + (ex.AUX,)
         return self._executor.jit(
             step,
             in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.BATCH, ex.BATCH,
                       ex.REPL, ex.BATCH, ex.BATCH, ex.BATCH),
-            out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL, ex.BATCH),
+            out_specs=out_specs,
             donate_argnums=(0, 1, 2))
 
     def _get_train_step(self, with_masks, with_carries):
@@ -386,6 +411,8 @@ class MultiLayerNetwork:
         xs, ys = jnp.asarray(xs), jnp.asarray(ys)
         if self._scan_fit is None:
             loss_fn = self._loss_for_grad()
+            rec = self._flight       # trace-build capture (see attach)
+            sample_k = rec.sample_every if rec is not None else 1
 
             def inner(params, state, opt_state, xs, ys, it0):
                 self._note_compile()
@@ -398,25 +425,45 @@ class MultiLayerNetwork:
                     (loss, (new_state, _)), grads = jax.value_and_grad(
                         loss_fn, has_aux=True)(params, state, x, y, rng,
                                                None, None, None)
-                    params, opt_state = self._dp_apply_updates(
+                    new_params, opt_state = self._dp_apply_updates(
                         params, opt_state, grads)
-                    return (params, new_state, opt_state, it + 1), loss
+                    if rec is None:
+                        return (new_params, new_state, opt_state,
+                                it + 1), loss
+                    from deeplearning4j_tpu.monitor import flight
+                    telem = flight.step_telemetry(
+                        flight.telemetry_triples(params, new_params, grads),
+                        it, sample_k)
+                    return (new_params, new_state, opt_state, it + 1), \
+                        (loss, telem)
 
-                (p, s, o, _), losses = jax.lax.scan(
+                (p, s, o, _), out = jax.lax.scan(
                     body, (params, state, opt_state, it0), (xs, ys))
-                return p, s, o, losses
+                if rec is None:
+                    return p, s, o, out
+                return p, s, o, out[0], out[1]
 
             from deeplearning4j_tpu import exec as ex
+            out_specs = (ex.PARAMS, ex.STATE, ex.OPT, ex.REPL)
+            if rec is not None:
+                out_specs = out_specs + (ex.AUX,)
             self._scan_fit = self._executor.jit(
                 inner,
                 in_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.STEP_BATCH,
                           ex.STEP_BATCH, ex.REPL),
-                out_specs=(ex.PARAMS, ex.STATE, ex.OPT, ex.REPL),
+                out_specs=out_specs,
                 donate_argnums=(0, 1, 2))
         c0, t0 = self._compile_count, time.perf_counter()
-        self.params, self.state, self.opt_state, losses = self._scan_fit(
-            self.params, self.state, self.opt_state, xs, ys,
-            jnp.asarray(self.iteration, jnp.int32))
+        if self._flight is not None:
+            (self.params, self.state, self.opt_state, losses,
+             telems) = self._scan_fit(
+                self.params, self.state, self.opt_state, xs, ys,
+                jnp.asarray(self.iteration, jnp.int32))
+            self._flight.record_scan(self.iteration, telems)
+        else:
+            self.params, self.state, self.opt_state, losses = self._scan_fit(
+                self.params, self.state, self.opt_state, xs, ys,
+                jnp.asarray(self.iteration, jnp.int32))
         self._last_input = xs[-1]     # device ref for activation capture
         self.iteration += int(xs.shape[0])
         self._epoch_batch += int(xs.shape[0])
@@ -730,12 +777,15 @@ class MultiLayerNetwork:
             self._fit_tbptt(x, y, mf, ml)
         else:
             step = self._get_train_step(mf is not None or ml is not None, False)
-            self.params, self.state, self.opt_state, loss, _ = step(
+            out = step(
                 self.params, self.state, self.opt_state, x, y,
                 jnp.asarray(self.iteration, jnp.int32), mf, ml, None)
+            self.params, self.state, self.opt_state, loss = out[:4]
             self._score = loss      # device scalar; host-read deferred to
                                     # get_score() (a sync costs ~100ms on
                                     # tunneled TPU attachments)
+            if self._flight is not None:
+                self._flight.record(self.iteration, out[5])
         self._last_fit_time = time.perf_counter() - t0
         self.iteration += 1
         self._epoch_batch += 1
@@ -802,16 +852,23 @@ class MultiLayerNetwork:
         carries = [None] * len(self.layers)
         step = self._get_train_step(mf is not None or ml is not None, True)
         losses = []
+        telem = None
         for start in range(0, T, L):
             xs = x[:, start:start + L]
             ys = y[:, start:start + L] if y.ndim == 3 else y
             mfs = None if mf is None else mf[:, start:start + L]
             mls = None if ml is None else ml[:, start:start + L]
-            self.params, self.state, self.opt_state, loss, carries = step(
+            out = step(
                 self.params, self.state, self.opt_state, xs, ys,
                 jnp.asarray(self.iteration, jnp.int32), mfs, mls, carries)
+            self.params, self.state, self.opt_state, loss, carries = out[:5]
+            if self._flight is not None:
+                telem = out[5]      # every chunk shares the iteration —
+                                    # the LAST chunk's stats are the record
             losses.append(loss)
         self._score = jnp.mean(jnp.stack(losses))   # device-side mean
+        if self._flight is not None and telem is not None:
+            self._flight.record(self.iteration, telem)
 
     # ------------------------------------------------------------- inference
     def serving_engine(self, **kw):

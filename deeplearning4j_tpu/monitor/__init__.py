@@ -22,6 +22,10 @@ Fleet additions (docs/OBSERVABILITY.md):
   health, wired into router and replica ``/healthz``.
 - ``profiling`` — ``POST /admin/profile`` around live traffic and
   ``DL4JTPU_PROFILE=dir`` around ``fit()``.
+- ``flight`` — the training flight recorder: per-layer telemetry
+  computed inside the jitted train step, a crash-safe ring of recent
+  records (``GET /train/diagnostics``), anomaly detection, Perfetto
+  counter tracks (``collect.flight_counter_events``).
 
 Both stores are cheap enough to leave on (see the bench's
 ``observability`` row); tracing is opt-in via ``trace.enable()`` /
@@ -36,7 +40,10 @@ from deeplearning4j_tpu.monitor.tracing import (
     Tracer, trace, get_tracer,
     TraceContext, set_context, get_context, trace_context)
 from deeplearning4j_tpu.monitor.slo import BurnRateSLO, SLOState
-from deeplearning4j_tpu.monitor.collect import collect_fleet_trace, merge_docs
+from deeplearning4j_tpu.monitor.collect import (
+    collect_fleet_trace, merge_docs, flight_counter_events)
+from deeplearning4j_tpu.monitor.flight import (
+    FlightRecorder, AnomalyDetector, STAT_COLS)
 from deeplearning4j_tpu.monitor.profiling import (
     start_profile, profile_status, profile_scope)
 
@@ -47,6 +54,7 @@ __all__ = [
     "Tracer", "trace", "get_tracer",
     "TraceContext", "set_context", "get_context", "trace_context",
     "BurnRateSLO", "SLOState",
-    "collect_fleet_trace", "merge_docs",
+    "collect_fleet_trace", "merge_docs", "flight_counter_events",
+    "FlightRecorder", "AnomalyDetector", "STAT_COLS",
     "start_profile", "profile_status", "profile_scope",
 ]

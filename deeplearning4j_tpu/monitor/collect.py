@@ -23,7 +23,8 @@ import json
 import urllib.request
 from typing import Iterable, Optional
 
-__all__ = ["fetch_json", "collect_fleet_trace", "merge_docs"]
+__all__ = ["fetch_json", "collect_fleet_trace", "merge_docs",
+           "flight_counter_events"]
 
 
 def fetch_json(url: str, timeout: float = 10.0) -> dict:
@@ -55,6 +56,31 @@ def merge_docs(docs: Iterable[dict], rebase: bool = True) -> dict:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+def flight_counter_events(diag: dict, pid: str = "train-telemetry") -> list:
+    """Perfetto counter-track events from a flight-recorder diagnostics
+    document (``GET /train/diagnostics``).
+
+    One ``ph: "C"`` event per (record, stat column) with per-layer series
+    in ``args`` — Perfetto renders each column as one multi-series
+    counter track (``train/grad_norm``, ``train/update_ratio``, ...)
+    under the given pid, on the SAME wall-clock µs timeline the span
+    tracer anchors to (``monitor/tracing.py``), so step telemetry lines
+    up with the fit spans in a merged fleet trace."""
+    events = [{"ph": "M", "pid": pid, "name": "process_name",
+               "args": {"name": pid}}]
+    cols = diag.get("cols", ())
+    for rec in diag.get("records", ()):
+        ts = float(rec["time"]) * 1e6        # wall-clock µs (tracer epoch)
+        layers = rec.get("layers", {})
+        for col in cols:
+            series = {name: stats.get(col, 0.0)
+                      for name, stats in layers.items()}
+            if series:
+                events.append({"ph": "C", "pid": pid, "ts": ts,
+                               "name": f"train/{col}", "args": series})
+    return events
+
+
 def collect_fleet_trace(router_url: str,
                         extra_urls: Iterable[str] = (),
                         path: Optional[str] = None,
@@ -84,6 +110,14 @@ def collect_fleet_trace(router_url: str,
             pulled.append(u)
         except Exception:
             continue
+        try:
+            # training telemetry counter tracks (members without a flight
+            # recorder answer 404 — skipped like any unreachable surface)
+            diag = fetch_json(u + "/train/diagnostics", timeout=timeout)
+            docs.append({"traceEvents": flight_counter_events(
+                diag, pid=f"train-telemetry {u}")})
+        except Exception:
+            pass
     doc = merge_docs(docs, rebase=rebase)
     doc["collectedFrom"] = pulled
     if path:

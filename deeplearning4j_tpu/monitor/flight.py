@@ -1,0 +1,580 @@
+"""Training flight recorder — in-trace per-layer telemetry + black box.
+
+The reference's training observability surface (ui-model
+``BaseStatsListener.iterationDone``: per-layer parameter/update summary
+stats, update:param ratios) syncs the full parameter tree to host numpy
+every iteration. That fights donation, breaks pipeline overlap, and
+ignores sharding. Here the telemetry is computed INSIDE the jitted train
+step: one small fused ``(L, 5)`` f32 side-output per step — per-layer
+grad-norm, update-norm, param-norm, update:param mean-magnitude ratio
+and a non-finite flag — sampled every K steps through a traced
+``lax.cond`` so the program count stays pinned (K is static at trace
+time; the skipped steps emit zeros without a second program).
+
+Host side, the :class:`FlightRecorder` keeps a bounded ring of recent
+step records with crash-safe periodic spill (atomic temp+fsync+rename,
+the same discipline as ``util/model_serializer``), so a SIGKILLed or
+NaN-diverged run leaves a readable last-N-steps black box naming the
+first layer that went non-finite. An :class:`AnomalyDetector` watches
+the drained records (grad-norm spike vs an EMA, update:param ratio out
+of the ``[1e-4, 1e-1]`` band, dead-update detection) and raises
+structured warnings that surface through ``health_info()``, the
+``dl4jtpu_train_layer_*`` gauges, ``GET /train/diagnostics`` and the
+Perfetto counter tracks merged by ``monitor/collect.py``.
+
+Device-sync discipline: ``record()`` stores the DEVICE array — the ring
+drains lazily (on read, spill, or once a small pending bound is hit), so
+the train loop never blocks on telemetry readback.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# Column order of the per-layer telemetry row. ``update_ratio`` is the
+# reference's update:param mean-magnitude ratio (the quantity its UI
+# plots on a log axis with the ~1e-3 rule of thumb); ``non_finite`` is
+# 1.0 when any gradient or updated parameter of the layer is inf/nan.
+STAT_COLS = ("grad_norm", "update_norm", "param_norm", "update_ratio",
+             "non_finite")
+N_COLS = len(STAT_COLS)
+
+_RATIO_EPS = 1e-12
+
+
+# --------------------------------------------------------------- in-trace
+def _row(old, new, grad):
+    """One telemetry row for one layer's (old params, new params, grads)
+    subtrees — all-f32 reductions, tolerant of empty (paramless) layers."""
+    import jax.numpy as jnp
+
+    leaves_old = [l for l in _tree_leaves(old)]
+    leaves_new = [l for l in _tree_leaves(new)]
+    leaves_g = [l for l in _tree_leaves(grad)]
+    if not leaves_new:
+        return jnp.zeros((N_COLS,), jnp.float32)
+    f32 = lambda t: t.astype(jnp.float32)  # noqa: E731
+    grad_sq = sum(jnp.sum(jnp.square(f32(g))) for g in leaves_g)
+    upd_sq, upd_abs, par_abs, par_sq, n = 0.0, 0.0, 0.0, 0.0, 0
+    finite = jnp.bool_(True)
+    for o, nw, g in zip(leaves_old, leaves_new, leaves_g):
+        u = f32(nw) - f32(o)
+        upd_sq = upd_sq + jnp.sum(jnp.square(u))
+        upd_abs = upd_abs + jnp.sum(jnp.abs(u))
+        par_abs = par_abs + jnp.sum(jnp.abs(f32(nw)))
+        par_sq = par_sq + jnp.sum(jnp.square(f32(nw)))
+        n += int(np.prod(nw.shape)) if nw.shape else 1
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(f32(nw))))
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(f32(g))))
+    ratio = upd_abs / (par_abs + _RATIO_EPS * max(n, 1))
+    return jnp.stack([jnp.sqrt(grad_sq), jnp.sqrt(upd_sq), jnp.sqrt(par_sq),
+                      ratio, 1.0 - finite.astype(jnp.float32)])
+
+
+def _tree_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def compute_telemetry(triples) -> Any:
+    """``(L, 5)`` f32 telemetry for a list of per-layer
+    ``(old_params, new_params, grads)`` subtree triples. Pure; traceable."""
+    import jax.numpy as jnp
+    return jnp.stack([_row(o, nw, g) for o, nw, g in triples])
+
+
+def step_telemetry(triples, it, sample_every: int) -> Any:
+    """The sampled side-output: ``compute_telemetry`` gated by a traced
+    ``it % K == 0`` predicate through ``lax.cond``. K is STATIC at trace
+    time — both branches live in the one compiled program, so attaching a
+    recorder never multiplies the program count. Non-sampled steps return
+    zeros (the host mirrors the predicate and ignores them)."""
+    import jax
+    import jax.numpy as jnp
+
+    k = max(int(sample_every), 1)
+    if k == 1:
+        return compute_telemetry(triples)
+    return jax.lax.cond(
+        (it % k) == 0,
+        lambda: compute_telemetry(triples),
+        lambda: jnp.zeros((len(triples), N_COLS), jnp.float32))
+
+
+def layer_names(model) -> List[str]:
+    """Display names for ALL layer groups of a container, index-aligned
+    with the telemetry rows (paramless layers keep their slot so row i is
+    always layer i). MLN: ``"{i}:{LayerType}"``; CG: the layer-node name
+    (the same convention ``ui/stats_listener`` uses)."""
+    if hasattr(model, "layers") and isinstance(
+            getattr(model, "params", None), list):
+        return [f"{i}:{type(l).__name__}"
+                for i, l in enumerate(model.layers)]
+    # ComputationGraph: params is Dict[name, Dict], ordered by topology
+    return [str(k) for k in model.params.keys()]
+
+
+def telemetry_triples(old_params, new_params, grads):
+    """Per-layer (old, new, grad) subtree triples in the container's
+    canonical layer order (list index for MLN, insertion order for CG)."""
+    if isinstance(new_params, list):
+        return [(old_params[i], new_params[i], grads[i])
+                for i in range(len(new_params))]
+    return [(old_params[k], new_params[k], grads[k])
+            for k in new_params.keys()]
+
+
+# ---------------------------------------------------------------- detector
+class AnomalyDetector:
+    """Structured training-anomaly state machine over drained records.
+
+    Kinds raised (each a dict ``{"kind", "layer", "iteration", "value",
+    "detail"}``):
+
+    - ``non_finite``   — the in-trace flag fired for a layer (inf/nan in
+      its grads or updated params). Degrades ``health_info()``.
+    - ``grad_spike``   — grad-norm > ``spike_factor`` × its per-layer EMA
+      (EMA folds in accepted observations only, after ``warmup`` of
+      them). Degrades ``health_info()`` while active.
+    - ``ratio_high`` / ``ratio_low`` — update:param mean-magnitude ratio
+      outside ``ratio_band`` (default ``[1e-4, 1e-1]``, the reference
+      UI's rule-of-thumb band). Warning only.
+    - ``dead_update``  — zero update-norm for ``dead_steps`` consecutive
+      sampled records on a layer that has params. Warning only.
+
+    Anomalies are "active" while raised within the last
+    ``active_window`` observed records.
+    """
+
+    DEGRADING = ("non_finite", "grad_spike")
+
+    def __init__(self, layer_names: Sequence[str],
+                 param_mask: Optional[Sequence[bool]] = None, *,
+                 spike_factor: float = 10.0, ema_alpha: float = 0.3,
+                 warmup: int = 3, ratio_band: Tuple[float, float] = (1e-4, 1e-1),
+                 dead_steps: int = 3, active_window: int = 5,
+                 max_anomalies: int = 256):
+        self.layer_names = list(layer_names)
+        L = len(self.layer_names)
+        self.param_mask = (list(param_mask) if param_mask is not None
+                           else [True] * L)
+        self.spike_factor = float(spike_factor)
+        self.ema_alpha = float(ema_alpha)
+        self.warmup = int(warmup)
+        self.ratio_band = (float(ratio_band[0]), float(ratio_band[1]))
+        self.dead_steps = int(dead_steps)
+        self.active_window = int(active_window)
+        self._ema = [None] * L          # per-layer grad-norm EMA
+        self._accepted = [0] * L        # observations folded into the EMA
+        self._dead_run = [0] * L        # consecutive zero-update records
+        self._observed = 0              # total records observed
+        self.anomalies: deque = deque(maxlen=max_anomalies)
+        self.first_non_finite: Optional[Dict[str, Any]] = None
+
+    def observe(self, iteration: int, stats: np.ndarray) -> List[Dict]:
+        """Feed one drained ``(L, 5)`` record; returns anomalies raised."""
+        raised: List[Dict] = []
+        self._observed += 1
+
+        def _raise(kind, i, value, detail):
+            a = {"kind": kind, "layer": self.layer_names[i],
+                 "iteration": int(iteration), "value": float(value),
+                 "detail": detail, "_seq": self._observed}
+            self.anomalies.append(a)
+            raised.append(a)
+            log.warning("training anomaly %s layer=%s it=%d value=%g (%s)",
+                        kind, a["layer"], iteration, a["value"], detail)
+
+        lo, hi = self.ratio_band
+        for i in range(len(self.layer_names)):
+            if not self.param_mask[i]:
+                continue
+            gn, un, pn, ratio, nf = (float(stats[i, c]) for c in range(N_COLS))
+            if nf > 0.0 or not all(np.isfinite(v) for v in (gn, un, pn)):
+                _raise("non_finite", i, 1.0,
+                       "inf/nan in layer grads or updated params")
+                if self.first_non_finite is None:
+                    self.first_non_finite = {
+                        "layer": self.layer_names[i],
+                        "iteration": int(iteration)}
+                continue
+            # grad-norm spike vs EMA (EMA folds in non-spike records only,
+            # so one spike doesn't mask the next)
+            ema = self._ema[i]
+            if (ema is not None and self._accepted[i] >= self.warmup
+                    and gn > self.spike_factor * max(ema, _RATIO_EPS)):
+                _raise("grad_spike", i, gn,
+                       f"grad-norm {gn:.3g} > {self.spike_factor:g}x "
+                       f"EMA {ema:.3g}")
+            else:
+                a = self.ema_alpha
+                self._ema[i] = gn if ema is None else (1 - a) * ema + a * gn
+                self._accepted[i] += 1
+            # dead-update: zero update-norm N sampled records in a row
+            if un == 0.0:
+                self._dead_run[i] += 1
+                if self._dead_run[i] == self.dead_steps:
+                    _raise("dead_update", i, 0.0,
+                           f"zero update-norm for {self.dead_steps} "
+                           "consecutive sampled steps")
+            else:
+                self._dead_run[i] = 0
+                # ratio band only judged on live layers with real updates
+                if ratio > hi:
+                    _raise("ratio_high", i, ratio,
+                           f"update:param ratio {ratio:.3g} > {hi:g}")
+                elif ratio < lo:
+                    _raise("ratio_low", i, ratio,
+                           f"update:param ratio {ratio:.3g} < {lo:g}")
+        return raised
+
+    def active(self) -> List[Dict]:
+        """Anomalies raised within the last ``active_window`` records."""
+        floor = self._observed - self.active_window
+        return [dict((k, v) for k, v in a.items() if k != "_seq")
+                for a in self.anomalies if a["_seq"] > floor]
+
+    def health_info(self) -> Optional[Dict[str, Any]]:
+        """Non-None degraded dict while a degrading anomaly is active (or
+        a non-finite was ever seen — that run's params are gone for good).
+        Composes with ``InferenceServer``'s ``health_hook`` chain."""
+        active = self.active()
+        bad = [a for a in active if a["kind"] in self.DEGRADING]
+        if self.first_non_finite is not None:
+            return {"status": "degraded", "reason": "train_non_finite",
+                    "first_non_finite": dict(self.first_non_finite),
+                    "active_anomalies": len(active)}
+        if bad:
+            return {"status": "degraded", "reason": "train_anomaly",
+                    "kinds": sorted({a["kind"] for a in bad}),
+                    "active_anomalies": len(active)}
+        return None
+
+
+# ---------------------------------------------------------------- recorder
+class FlightRecorder:
+    """Bounded ring of recent train-step telemetry records, the black box.
+
+    Attach with ``model.attach_flight_recorder(rec)`` — the container
+    re-traces its train step once with the fused side-output and hands
+    every sampled ``(L, 5)`` device array to :meth:`record` (or a stacked
+    scan block to :meth:`record_scan`). Draining to host is LAZY: device
+    arrays queue in a small pending deque and materialize only on read,
+    on spill, or when the pending bound is hit — the train loop never
+    blocks on telemetry readback.
+
+    ``spill_path`` enables the crash-safe black box: every
+    ``spill_every`` drained records (and IMMEDIATELY when a layer goes
+    non-finite) the ring is written whole via atomic temp+fsync+rename,
+    so a SIGKILL between spills loses at most ``spill_every`` records and
+    a NaN-diverged run always leaves the record naming the first
+    non-finite layer. :meth:`restore` reads it back.
+    """
+
+    SPILL_VERSION = 1
+    _PENDING_BOUND = 8
+
+    def __init__(self, *, capacity: int = 256, sample_every: int = 1,
+                 spill_path: Optional[str] = None, spill_every: int = 50,
+                 detector: Optional[AnomalyDetector] = None):
+        if int(sample_every) < 1:
+            raise ValueError("sample_every must be >= 1")
+        self.capacity = int(capacity)
+        self.sample_every = int(sample_every)
+        self.spill_path = spill_path
+        self.spill_every = int(spill_every)
+        self.layer_names: List[str] = []
+        self.detector = detector
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._pending: deque = deque()
+        self._lock = threading.RLock()
+        self._since_spill = 0
+        self._spills = 0
+        self._gauges = None           # lazy metric children, built on bind
+        self._m_anom = None
+        self._m_spills = None
+        self._m_records = None
+
+    # ------------------------------------------------------------- binding
+    def bind(self, model) -> "FlightRecorder":
+        """Learn the model's layer-group names (index-aligned with the
+        telemetry rows) and build the detector + metric children."""
+        self.layer_names = layer_names(model)
+        if isinstance(model.params, list):
+            mask = [bool(_np_leaves(p)) for p in model.params]
+        else:
+            mask = [bool(_np_leaves(model.params[k]))
+                    for k in model.params.keys()]
+        if self.detector is None:
+            self.detector = AnomalyDetector(self.layer_names, mask)
+        self._build_metrics()
+        return self
+
+    def _build_metrics(self):
+        from deeplearning4j_tpu.monitor.metrics import get_registry
+        reg = get_registry()
+        fams = {
+            "grad_norm": reg.gauge(
+                "dl4jtpu_train_layer_grad_norm",
+                "Per-layer gradient L2 norm from the in-trace train-step "
+                "side-output (latest sampled step)", ["layer"]),
+            "update_norm": reg.gauge(
+                "dl4jtpu_train_layer_update_norm",
+                "Per-layer parameter-update L2 norm (latest sampled step)",
+                ["layer"]),
+            "param_norm": reg.gauge(
+                "dl4jtpu_train_layer_param_norm",
+                "Per-layer parameter L2 norm after the update "
+                "(latest sampled step)", ["layer"]),
+            "update_ratio": reg.gauge(
+                "dl4jtpu_train_layer_update_ratio",
+                "Per-layer update:param mean-magnitude ratio "
+                "(latest sampled step)", ["layer"]),
+            "non_finite": reg.gauge(
+                "dl4jtpu_train_layer_non_finite",
+                "1 when the layer's grads or updated params contained "
+                "inf/nan at the latest sampled step", ["layer"]),
+        }
+        self._gauges = {
+            col: [fams[col].labels(layer=n) for n in self.layer_names]
+            for col in fams}
+        self._m_anom = reg.counter(
+            "dl4jtpu_train_anomalies_total",
+            "Training anomalies raised by the flight recorder's detector",
+            ["kind"])
+        self._m_spills = reg.counter(
+            "dl4jtpu_train_flight_spills_total",
+            "Flight-recorder ring spills written (atomic temp+rename)")
+        self._m_records = reg.gauge(
+            "dl4jtpu_train_flight_records",
+            "Telemetry records currently held in the flight-recorder ring")
+
+    # ------------------------------------------------------------ recording
+    def sampled(self, iteration: int) -> bool:
+        """Host mirror of the traced ``it % K == 0`` predicate."""
+        return int(iteration) % self.sample_every == 0
+
+    def record(self, iteration: int, stats) -> None:
+        """Queue one step's ``(L, 5)`` telemetry (device array kept as-is;
+        no sync here). Non-sampled iterations are ignored — the traced
+        predicate already zeroed them."""
+        if not self.sampled(iteration):
+            return
+        with self._lock:
+            self._pending.append((int(iteration), time.time(), stats, None))
+            if len(self._pending) >= self._PENDING_BOUND:
+                self._drain()
+
+    def record_scan(self, it0: int, block) -> None:
+        """Queue a ``fit_scan`` block: ``block`` is the stacked
+        ``(n_steps, L, 5)`` scan output for iterations ``it0..it0+n-1``.
+        Kept whole (one device array) and sliced at drain time."""
+        n = int(block.shape[0])
+        sampled = [i for i in range(n) if self.sampled(it0 + i)]
+        if not sampled:
+            return
+        with self._lock:
+            self._pending.append((int(it0), time.time(), block, sampled))
+            if len(self._pending) >= self._PENDING_BOUND:
+                self._drain()
+
+    def _drain(self) -> None:
+        """Materialize pending device arrays, feed the detector, refresh
+        gauges, spill if due. Called under the lock."""
+        while self._pending:
+            it0, ts, stats, scan_idx = self._pending.popleft()
+            arr = np.asarray(stats, dtype=np.float32)
+            rows = ([(it0, arr)] if scan_idx is None
+                    else [(it0 + i, arr[i]) for i in scan_idx])
+            for it, a in rows:
+                rec = {"iteration": int(it), "time": float(ts),
+                       "stats": a}
+                self._ring.append(rec)
+                self._since_spill += 1
+                raised = (self.detector.observe(it, a)
+                          if self.detector is not None else [])
+                if self._m_anom is not None:
+                    for an in raised:
+                        self._m_anom.labels(kind=an["kind"]).inc()
+                nonfinite = any(an["kind"] == "non_finite" for an in raised)
+                if self.spill_path and (
+                        nonfinite
+                        or (self.spill_every
+                            and self._since_spill >= self.spill_every)):
+                    self._spill_locked()
+        if self._ring and self._gauges is not None:
+            last = self._ring[-1]["stats"]
+            L = min(len(self.layer_names), last.shape[0])
+            for c, col in enumerate(STAT_COLS):
+                for i in range(L):
+                    self._gauges[col][i].set(float(last[i, c]))
+        if self._m_records is not None:
+            self._m_records.set(len(self._ring))
+
+    # --------------------------------------------------------------- reads
+    def drain(self) -> None:
+        with self._lock:
+            self._drain()
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        """Most recent drained record (``{"iteration", "time", "stats"}``
+        with ``stats`` a ``(L, 5)`` numpy array) or None."""
+        with self._lock:
+            self._drain()
+            return self._ring[-1] if self._ring else None
+
+    def records(self, last: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            self._drain()
+            out = list(self._ring)
+        return out[-last:] if last else out
+
+    def first_non_finite(self) -> Optional[Dict[str, Any]]:
+        """``{"layer", "iteration"}`` of the first layer that went
+        non-finite, or None while training is healthy."""
+        with self._lock:
+            self._drain()
+            if self.detector is None:
+                return None
+            fnf = self.detector.first_non_finite
+            return dict(fnf) if fnf else None
+
+    def anomalies(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            self._drain()
+            return self.detector.active() if self.detector else []
+
+    def health_info(self) -> Optional[Dict[str, Any]]:
+        """Degraded dict while a degrading anomaly is active; None when
+        healthy. Shaped for ``InferenceServer(health_hook=...)``."""
+        with self._lock:
+            self._drain()
+            return (self.detector.health_info()
+                    if self.detector is not None else None)
+
+    def diagnostics(self, last: int = 32) -> Dict[str, Any]:
+        """The ``GET /train/diagnostics`` document: recent records (layer
+        stats keyed by name), active anomalies, first non-finite layer."""
+        with self._lock:
+            self._drain()
+            recs = list(self._ring)[-last:]
+            doc = {
+                "layers": list(self.layer_names),
+                "cols": list(STAT_COLS),
+                "sample_every": self.sample_every,
+                "capacity": self.capacity,
+                "records": [self._rec_doc(r) for r in recs],
+                "anomalies": self.detector.active() if self.detector else [],
+                "first_non_finite": (dict(self.detector.first_non_finite)
+                                     if self.detector is not None
+                                     and self.detector.first_non_finite
+                                     else None),
+                "spills": self._spills,
+            }
+        return doc
+
+    def _rec_doc(self, rec) -> Dict[str, Any]:
+        stats = rec["stats"]
+        return {
+            "iteration": rec["iteration"], "time": rec["time"],
+            "layers": {
+                name: {col: _jsonf(stats[i, c])
+                       for c, col in enumerate(STAT_COLS)}
+                for i, name in enumerate(self.layer_names)
+                if i < stats.shape[0]}}
+
+    # --------------------------------------------------------------- spill
+    def spill(self, path: Optional[str] = None) -> str:
+        """Write the ring (+ anomaly state) to ``path`` (default
+        ``spill_path``) via atomic temp+fsync+rename."""
+        with self._lock:
+            self._drain()
+            return self._spill_locked(path)
+
+    def _spill_locked(self, path: Optional[str] = None) -> str:
+        path = path or self.spill_path
+        if not path:
+            raise ValueError("no spill path configured")
+        doc = {
+            "version": self.SPILL_VERSION,
+            "layer_names": list(self.layer_names),
+            "cols": list(STAT_COLS),
+            "sample_every": self.sample_every,
+            "records": [{"iteration": r["iteration"], "time": r["time"],
+                         "stats": [[_jsonf(v) for v in row]
+                                   for row in np.asarray(r["stats"])]}
+                        for r in self._ring],
+            "anomalies": [dict((k, v) for k, v in a.items() if k != "_seq")
+                          for a in (self.detector.anomalies
+                                    if self.detector else [])],
+            "first_non_finite": (dict(self.detector.first_non_finite)
+                                 if self.detector is not None
+                                 and self.detector.first_non_finite
+                                 else None),
+        }
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._since_spill = 0
+        self._spills += 1
+        if self._m_spills is not None:
+            self._m_spills.inc()
+        return path
+
+    @staticmethod
+    def restore(path: str) -> Dict[str, Any]:
+        """Read a spilled flight record back (the post-mortem reader).
+        Returns the spill document with ``stats`` as numpy arrays."""
+        with open(path) as fh:
+            doc = json.load(fh)
+        for r in doc.get("records", []):
+            r["stats"] = np.asarray(r["stats"], dtype=np.float32)
+        return doc
+
+
+def _jsonf(v) -> float:
+    """JSON-safe float: inf/nan are not valid JSON numbers — encode them
+    the way the rest of the fleet surface does (clamped sentinel)."""
+    v = float(v)
+    if np.isnan(v):
+        return 0.0          # the non_finite column still carries the flag
+    if np.isinf(v):
+        return 1e308 if v > 0 else -1e308
+    return v
+
+
+def _np_leaves(tree) -> list:
+    """Leaves of a plain nested dict/list params subtree (host-side; no
+    jax import needed for bind-time masks)."""
+    out = []
+    if isinstance(tree, dict):
+        for v in tree.values():
+            out.extend(_np_leaves(v))
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            out.extend(_np_leaves(v))
+    elif tree is not None:
+        out.append(tree)
+    return out

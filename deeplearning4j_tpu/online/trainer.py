@@ -83,9 +83,15 @@ class BatchGuard:
         self._accepted += 1
         return None
 
-    def _reject(self, reason: str) -> str:
+    def _reject(self, reason: str, layer: Optional[str] = None) -> str:
+        # layer provenance (from the model's flight recorder) rides a
+        # SECOND suffixed label value — the plain reason keeps counting,
+        # so existing `{reason="non_finite"}` consumers never break
         self._m_quarantined.labels(reason=reason).inc()
-        log.warning("online guard quarantined a batch: %s", reason)
+        if layer:
+            self._m_quarantined.labels(reason=f"{reason}:{layer}").inc()
+        log.warning("online guard quarantined a batch: %s%s", reason,
+                    f" (layer {layer})" if layer else "")
         return reason
 
 
@@ -175,12 +181,34 @@ class OnlineTrainer:
             post = float(self.model.score(x=last_f, y=last_l))
             if not math.isfinite(post):
                 self._m_quarantined.labels(reason="post_step_non_finite").inc()
+                # per-layer provenance from the flight recorder (when one
+                # is attached): a second suffixed label value names the
+                # first layer that went non-finite — the plain reason
+                # above keeps its count, so existing consumers still work
+                layer = self._non_finite_layer()
+                if layer:
+                    self._m_quarantined.labels(
+                        reason=f"post_step_non_finite:{layer}").inc()
                 restored = self.resume()
-                log.error("online trainer: non-finite loss AFTER fitting; "
-                          "weights restored from %s", restored)
+                log.error("online trainer: non-finite loss AFTER fitting"
+                          "%s; weights restored from %s",
+                          f" (first non-finite layer: {layer})"
+                          if layer else "", restored)
                 return None
         self.rounds += 1
         return self.checkpoints.save(self.model)
+
+    def _non_finite_layer(self) -> Optional[str]:
+        """The first layer the model's flight recorder saw go non-finite
+        (None without a recorder, or while training is still finite)."""
+        rec = getattr(self.model, "_flight", None)
+        if rec is None:
+            return None
+        try:
+            fnf = rec.first_non_finite()
+        except Exception:
+            return None
+        return fnf["layer"] if fnf else None
 
     # -- health ------------------------------------------------------------
 

@@ -58,7 +58,7 @@ from deeplearning4j_tpu.serving.engine import InferenceEngine
 
 _KNOWN_PATHS = ("/predict", "/generate", "/warmup", "/stats", "/metrics",
                 "/healthz", "/chaos", "/admin/swap", "/trace", "/programs",
-                "/admin/profile")
+                "/admin/profile", "/train/diagnostics")
 
 
 def _http_metrics():
@@ -166,6 +166,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/programs":
                 from deeplearning4j_tpu.exec.programs import get_programs
                 self._json({"programs": get_programs().entries()})
+            elif path == "/train/diagnostics":
+                # the flight recorder's black box: recent per-layer step
+                # records + active anomalies (monitor/flight.py)
+                if srv.flight_recorder is None:
+                    self._error(404, "not_found",
+                                "no flight recorder attached to this server")
+                else:
+                    self._json(srv.flight_recorder.diagnostics())
             else:
                 self._error(404, "not_found", f"no such path: {path}")
 
@@ -367,7 +375,8 @@ class InferenceServer:
                  max_queue: int = 1024,
                  request_timeout_ms: Optional[float] = None,
                  decode_engine=None, fault_injector=None,
-                 health_hook=None, request_mirror=None):
+                 health_hook=None, request_mirror=None,
+                 flight_recorder=None):
         self.engine = engine or InferenceEngine(model)
         # serving/decode.DecodeEngine for POST /generate (None = endpoint
         # answers 404; predict-only servers don't pay for decode slots)
@@ -383,6 +392,10 @@ class InferenceServer:
         # request_mirror: (features ndarray) -> None — best-effort tap on
         # /predict traffic (online/gate.TrafficMirror shadow evaluation)
         self.request_mirror = request_mirror
+        # flight_recorder: monitor/flight.FlightRecorder — exposes the
+        # training black box at GET /train/diagnostics (None = 404) and
+        # degrades /healthz while a degrading training anomaly is active
+        self.flight_recorder = flight_recorder
         self.batcher = MicroBatcher(self.engine, max_batch=max_batch,
                                     max_latency_ms=max_latency_ms,
                                     max_queue=max_queue)
@@ -481,6 +494,13 @@ class InferenceServer:
                 extra = None    # the whole server unhealthy
             if extra and extra.get("status") not in (None, "ok"):
                 return extra
+        if self.flight_recorder is not None:
+            try:
+                fr = self.flight_recorder.health_info()
+            except Exception:   # noqa: BLE001 — telemetry can't take the
+                fr = None       # whole server unhealthy
+            if fr and fr.get("status") not in (None, "ok"):
+                return fr
         try:
             slo = self.slo.evaluate()
         except Exception:       # noqa: BLE001 — SLO math can't break health

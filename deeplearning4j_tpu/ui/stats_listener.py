@@ -57,13 +57,24 @@ def _summary(model, tree) -> dict:
 
 
 class StatsListener(IterationListener):
+    """``numpy_stats=True`` forces the legacy full-tree host-numpy stats
+    path even when the model has a flight recorder attached — the parity
+    oracle for tests, not a production mode: it ``np.asarray``s every
+    param leaf (a host sync that fights donation) and keeps a full host
+    copy between iterations to compute update deltas. With a recorder
+    attached (``model.attach_flight_recorder``) the default path reads
+    the in-trace ``(L, 5)`` side-output instead — no param leaf ever
+    crosses to host on the hot path."""
+
     def __init__(self, storage, frequency: int = 1,
                  session_id: Optional[str] = None,
-                 collect_param_stats: bool = True):
+                 collect_param_stats: bool = True,
+                 numpy_stats: bool = False):
         self.storage = storage
         self.frequency = max(1, frequency)
         self.session_id = session_id or f"session_{uuid.uuid4().hex[:10]}"
         self.collect_param_stats = collect_param_stats
+        self.numpy_stats = numpy_stats
         self._last_time = None
         self._last_params = None
         self._static_sent = False
@@ -126,16 +137,49 @@ class StatsListener(IterationListener):
         r.mem_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
 
         if self.collect_param_stats and model.params is not None:
-            r.param_stats = _summary(model, model.params)
-            if self._last_params is not None:
-                delta = jax.tree_util.tree_map(
-                    lambda a, b: np.asarray(a) - np.asarray(b),
-                    model.params, self._last_params)
-                r.update_stats = _summary(model, delta)
-            self._last_params = jax.tree_util.tree_map(np.asarray, model.params)
+            rec = getattr(model, "_flight", None)
+            if rec is not None and not self.numpy_stats:
+                # in-trace side-output path: the recorder's latest (L, 5)
+                # record already holds the per-layer norms — no host sync
+                # of any param leaf
+                self._recorder_stats(r, rec)
+            else:
+                r.param_stats = _summary(model, model.params)
+                if self._last_params is not None:
+                    delta = jax.tree_util.tree_map(
+                        lambda a, b: np.asarray(a) - np.asarray(b),
+                        model.params, self._last_params)
+                    r.update_stats = _summary(model, delta)
+                self._last_params = jax.tree_util.tree_map(
+                    np.asarray, model.params)
 
         gc = model.conf.global_conf
         upd = getattr(gc, "updater", None)
         if upd is not None and hasattr(upd, "learning_rate"):
             r.learning_rates = {"global": float(upd.learning_rate)}
         self.storage.put_update(r)
+
+    def _recorder_stats(self, r, rec):
+        """Per-layer stats from the flight recorder's latest record: the
+        reduced summary the TrainModule charts actually plot (norms +
+        the update:param mean-magnitude ratio), keyed by the same layer
+        names the numpy path uses."""
+        from deeplearning4j_tpu.monitor.flight import STAT_COLS
+        latest = rec.latest()
+        if latest is None:
+            return
+        stats, col = latest["stats"], {c: i for i, c in enumerate(STAT_COLS)}
+        mask = rec.detector.param_mask if rec.detector is not None else None
+        r.param_stats, r.update_stats = {}, {}
+        for i, name in enumerate(rec.layer_names):
+            if i >= stats.shape[0] or (mask is not None and not mask[i]):
+                continue              # paramless layers keep no chart row
+            r.param_stats[name] = {
+                "norm": float(stats[i, col["param_norm"]]),
+            }
+            r.update_stats[name] = {
+                "norm": float(stats[i, col["update_norm"]]),
+                "grad_norm": float(stats[i, col["grad_norm"]]),
+                "ratio": float(stats[i, col["update_ratio"]]),
+                "non_finite": float(stats[i, col["non_finite"]]),
+            }

@@ -42,6 +42,19 @@ def test_train_perf_row_fast():
     assert bf16["mfu"] is not None
 
 
+def test_train_telemetry_row_fast():
+    row = bench.bench_train_telemetry(fast=True)
+    # the function itself asserts bitwise score parity across recorder
+    # off / K=1 / K=20, the pinned one-program-per-config compile count,
+    # and the K-cadence of recorded iterations; the <3% overhead bar is
+    # full-mode-only (see module docstring). Here we pin the row shape.
+    assert row["unit"] == "percent"
+    assert row["bitwise_identical_score"] is True
+    assert row["cadence_ok"] is True
+    assert row["compiled_programs"] == [1, 1, 1]
+    assert row["records_k1"] > row["records_k20"] > 0
+
+
 def test_kv_storm_row_fast():
     row = bench.bench_kv_storm(fast=True)
     # the function itself asserts dense/paged bitwise output parity, the

@@ -1,0 +1,476 @@
+"""Training flight recorder: in-trace telemetry, anomalies, black box.
+
+The load-bearing claims pinned here:
+- attaching a recorder leaves training BITWISE-identical (fit and
+  fit_scan, MLN and CG, fused and per-leaf updater paths) at the SAME
+  pinned compile count — the telemetry is one fused side-output of the
+  one train-step program, and the K-sampling predicate is traced, so
+  changing nothing but the recorder never adds a program;
+- sampling cadence: only iterations with ``it % K == 0`` land in the
+  ring, for the per-step path and for ``fit_scan`` blocks;
+- the telemetry values are the real norms (update-norm matches the
+  host-computed ``||new - old||``);
+- the crash-safe spill: periodic spills leave a readable strict-prefix
+  black box when the process dies between spills (simulated SIGKILL =
+  read the file without the final ``spill()``), and a NaN-diverged run
+  auto-spills a record naming the FIRST non-finite layer;
+- the AnomalyDetector state machine (grad_spike vs EMA, ratio band,
+  dead_update, sticky non_finite) and its ``health_info()`` contract;
+- StatsListener's default recorder path syncs NO param leaf to host
+  (the numpy path stays available as the parity oracle);
+- the online trainer's post-step quarantine counter carries layer
+  provenance as a SECOND suffixed label value (the plain reason keeps
+  counting);
+- ``GET /train/diagnostics`` serves the document (404 without a
+  recorder) and ``flight_counter_events`` turns it into mergeable
+  Perfetto counter tracks.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (ComputationGraph, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.monitor.collect import (flight_counter_events,
+                                                merge_docs)
+from deeplearning4j_tpu.monitor.flight import (AnomalyDetector,
+                                               FlightRecorder, STAT_COLS)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+
+COL = {c: i for i, c in enumerate(STAT_COLS)}
+
+
+def _mlp(seed=42, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _cg(seed=7):
+    g = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+         .weight_init("xavier")
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.feed_forward(6))
+         .add_layer("h", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "h")
+         .set_outputs("out").build())
+    return ComputationGraph(g).init()
+
+
+def _data(n_in, n_out, batch=8, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(batch, n_in).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rs.randint(0, n_out, batch)]
+    return x, y
+
+
+def _leaves(tree):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def _bitwise(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(x, y) for x, y in zip(la, lb))
+
+
+def _counter_value(name, **labels):
+    fam = get_registry()._families.get(name)
+    if fam is None:
+        return 0.0
+    if not fam.labelnames:
+        return fam.value
+    want = tuple(str(labels[k]) for k in fam.labelnames)
+    for key, child in fam.children():
+        if key == want:
+            return child.value
+    return 0.0
+
+
+# ------------------------------------------------- bitwise + compile pins
+
+def test_mln_fit_bitwise_on_vs_off_and_cadence():
+    x, y = _data(4, 3)
+    off, on = _mlp(), _mlp()
+    rec = FlightRecorder(sample_every=2, capacity=64)
+    on.attach_flight_recorder(rec)
+    for _ in range(5):
+        off.fit(x, y)
+        on.fit(x, y)
+    assert _bitwise(off.params, on.params)
+    assert off._compile_count == on._compile_count == 1
+    its = [r["iteration"] for r in rec.records()]
+    assert its == [0, 2, 4]                       # K-cadence, per-step path
+    assert rec.layer_names == ["0:DenseLayer", "1:OutputLayer"]
+
+
+def test_mln_fit_scan_bitwise_on_vs_off_and_cadence():
+    rs = np.random.RandomState(3)
+    xs = rs.randn(6, 8, 4).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (6, 8))]
+    off, on = _mlp(), _mlp()
+    rec = FlightRecorder(sample_every=2, capacity=64)
+    on.attach_flight_recorder(rec)
+    off.fit_scan(xs, ys)
+    on.fit_scan(xs, ys)
+    assert _bitwise(off.params, on.params)
+    assert off._compile_count == on._compile_count == 1
+    assert [r["iteration"] for r in rec.records()] == [0, 2, 4]
+
+
+def test_cg_fit_and_scan_bitwise_layer_names():
+    x, y = _data(6, 3, seed=5)
+    off, on = _cg(), _cg()
+    rec = FlightRecorder(sample_every=1, capacity=64)
+    on.attach_flight_recorder(rec)
+    for _ in range(3):
+        off.fit(x, y)
+        on.fit(x, y)
+    assert _bitwise(off.params, on.params)
+    assert off._compile_count == on._compile_count == 1
+    assert rec.layer_names == ["h", "out"]
+    assert [r["iteration"] for r in rec.records()] == [0, 1, 2]
+
+    rs = np.random.RandomState(9)
+    xs = rs.randn(4, 8, 6).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (4, 8))]
+    off2, on2 = _cg(), _cg()
+    on2.attach_flight_recorder(FlightRecorder(sample_every=2))
+    off2.fit_scan(xs, ys)
+    on2.fit_scan(xs, ys)
+    assert _bitwise(off2.params, on2.params)
+    assert [r["iteration"] for r in on2._flight.records()] == [0, 2]
+
+
+def test_per_leaf_updater_path_bitwise_on_vs_off():
+    # the recorder composes with the per-leaf (non-fused) optimizer loop —
+    # the fused path's parity oracle — identically
+    from deeplearning4j_tpu.nn import fused_update
+    x, y = _data(4, 3, seed=11)
+    fused_update.set_fused_update(False)
+    try:
+        off, on = _mlp(), _mlp()
+        on.attach_flight_recorder(FlightRecorder())
+        for _ in range(3):
+            off.fit(x, y)
+            on.fit(x, y)
+        assert _bitwise(off.params, on.params)
+        assert off._compile_count == on._compile_count == 1
+    finally:
+        fused_update.set_fused_update(True)
+
+
+# --------------------------------------------------------- value sanity
+
+def test_telemetry_values_match_host_norms():
+    x, y = _data(4, 3, seed=2)
+    net = _mlp()
+    rec = FlightRecorder(sample_every=1)
+    net.attach_flight_recorder(rec)
+    old = [_leaves(p) for p in net.params]
+    net.fit(x, y)
+    new = [_leaves(p) for p in net.params]
+    stats = rec.latest()["stats"]
+    assert stats.shape == (2, len(STAT_COLS))
+    for i in range(2):
+        upd = np.sqrt(sum(((b.astype(np.float64) - a) ** 2).sum()
+                          for a, b in zip(old[i], new[i])))
+        par = np.sqrt(sum((b.astype(np.float64) ** 2).sum()
+                          for b in new[i]))
+        assert np.isclose(stats[i, COL["update_norm"]], upd, rtol=1e-4)
+        assert np.isclose(stats[i, COL["param_norm"]], par, rtol=1e-4)
+        assert stats[i, COL["grad_norm"]] > 0.0
+        assert stats[i, COL["non_finite"]] == 0.0
+
+
+# ------------------------------------------------------- crash-safe spill
+
+def test_periodic_spill_leaves_prefix_after_simulated_sigkill(tmp_path):
+    path = str(tmp_path / "flight.json")
+    x, y = _data(4, 3, seed=4)
+    net = _mlp()
+    rec = FlightRecorder(sample_every=1, capacity=64,
+                         spill_path=path, spill_every=3)
+    net.attach_flight_recorder(rec)
+    # 9 iterations: the pending bound (8) forces one lazy drain, which
+    # fires the every-3-records periodic spills; iteration 8 stays
+    # pending and iterations 6..7 post-date the last spill
+    for _ in range(9):
+        net.fit(x, y)
+    # simulated SIGKILL: read the file WITHOUT spill()/drain on this rec
+    doc = FlightRecorder.restore(path)
+    its = [r["iteration"] for r in doc["records"]]
+    assert its == [0, 1, 2, 3, 4, 5]              # strict prefix survives
+    assert doc["layer_names"] == rec.layer_names
+    assert doc["cols"] == list(STAT_COLS)
+    assert doc["records"][0]["stats"].shape == (2, len(STAT_COLS))
+    assert doc["first_non_finite"] is None
+    # a live process can always force the full ring out
+    rec.spill()
+    full = FlightRecorder.restore(path)
+    assert [r["iteration"] for r in full["records"]] == list(range(9))
+
+
+def test_nan_run_auto_spills_first_non_finite_layer(tmp_path):
+    path = str(tmp_path / "blackbox.json")
+    x, y = _data(4, 3, seed=6)
+    net = _mlp()
+    rec = FlightRecorder(sample_every=1, spill_path=path, spill_every=10_000)
+    net.attach_flight_recorder(rec)
+    net.fit(x, y)                                  # one healthy step
+    bad = x.copy()
+    bad[0, 0] = np.nan
+    net.fit(bad, y)                                # poisons layer 0 forward
+    fnf = rec.first_non_finite()
+    assert fnf == {"layer": "0:DenseLayer", "iteration": 1}
+    h = rec.health_info()
+    assert h["status"] == "degraded" and h["reason"] == "train_non_finite"
+    # the auto-spill fired on the non-finite record itself — the black
+    # box on disk already names the layer, no clean shutdown needed
+    doc = FlightRecorder.restore(path)
+    assert doc["first_non_finite"]["layer"] == "0:DenseLayer"
+    assert any(a["kind"] == "non_finite" for a in doc["anomalies"])
+    assert json.load(open(path)) is not None       # valid JSON (no inf/nan)
+
+
+# ------------------------------------------------------- anomaly machine
+
+def _rows(gn=1.0, un=1e-2, pn=1.0, ratio=1e-2, nf=0.0, L=2, **overrides):
+    """(L, 5) record; overrides like ``gn0=50`` target one layer."""
+    a = np.zeros((L, len(STAT_COLS)), np.float32)
+    for i in range(L):
+        vals = {"gn": gn, "un": un, "pn": pn, "ratio": ratio, "nf": nf}
+        for k, v in overrides.items():
+            if k.endswith(str(i)):
+                vals[k[:-len(str(i))]] = v
+        a[i] = [vals["gn"], vals["un"], vals["pn"], vals["ratio"],
+                vals["nf"]]
+    return a
+
+
+def test_anomaly_detector_grad_spike_and_recovery():
+    det = AnomalyDetector(["a", "b"])
+    it = 0
+    for _ in range(4):                             # warmup, all accepted
+        assert det.observe(it, _rows()) == []
+        it += 1
+    raised = det.observe(it, _rows(gn0=50.0))      # 50 > 10x EMA(=1)
+    assert [a["kind"] for a in raised] == ["grad_spike"]
+    assert raised[0]["layer"] == "a"
+    h = det.health_info()
+    assert h["status"] == "degraded" and h["reason"] == "train_anomaly"
+    assert h["kinds"] == ["grad_spike"]
+    for _ in range(5):                             # ages out of the window
+        it += 1
+        det.observe(it, _rows())
+    assert det.active() == []
+    assert det.health_info() is None
+
+
+def test_anomaly_detector_ratio_band_and_dead_update():
+    det = AnomalyDetector(["a", "b"])
+    for it in range(3):
+        det.observe(it, _rows())
+    hi = det.observe(3, _rows(ratio1=0.5))
+    assert [(a["kind"], a["layer"]) for a in hi] == [("ratio_high", "b")]
+    lo = det.observe(4, _rows(ratio0=1e-6))
+    assert [(a["kind"], a["layer"]) for a in lo] == [("ratio_low", "a")]
+    # ratio anomalies never degrade health
+    assert det.health_info() is None
+    # dead_update: fires once, at exactly dead_steps consecutive zeros
+    assert det.observe(5, _rows(un0=0.0)) == []
+    assert det.observe(6, _rows(un0=0.0)) == []
+    dead = det.observe(7, _rows(un0=0.0))
+    assert [(a["kind"], a["layer"]) for a in dead] == [("dead_update", "a")]
+    assert det.observe(8, _rows(un0=0.0)) == []    # no re-raise while dead
+    assert det.observe(9, _rows()) == []           # recovery resets the run
+
+
+def test_anomaly_detector_non_finite_sticky_and_mask():
+    det = AnomalyDetector(["a", "b"])
+    raised = det.observe(0, _rows(nf1=1.0))
+    assert [(a["kind"], a["layer"]) for a in raised] == [("non_finite", "b")]
+    assert det.first_non_finite == {"layer": "b", "iteration": 0}
+    for it in range(1, 10):                        # sticky: never recovers
+        det.observe(it, _rows())
+    h = det.health_info()
+    assert h["status"] == "degraded" and h["reason"] == "train_non_finite"
+    assert h["first_non_finite"]["layer"] == "b"
+    # a masked (paramless) layer's rows are never judged
+    det2 = AnomalyDetector(["a", "b"], [True, False])
+    assert det2.observe(0, _rows(nf1=1.0)) == []
+    assert det2.first_non_finite is None
+
+
+# ------------------------------------------------------- StatsListener
+
+def test_stats_listener_recorder_path_syncs_no_params(monkeypatch):
+    from deeplearning4j_tpu.ui import stats_listener as sl
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    # the legacy numpy path's only entry points are _summary and the
+    # _last_params host copy — poison the former and watch the latter
+    def _boom(*a, **k):
+        raise AssertionError("recorder path must not host-sync params")
+    monkeypatch.setattr(sl, "_summary", _boom)
+
+    x, y = _data(4, 3, seed=8)
+    net = _mlp()
+    net.attach_flight_recorder(FlightRecorder(sample_every=1))
+    storage = InMemoryStatsStorage()
+    lst = sl.StatsListener(storage, session_id="flight_sess")
+    net.set_listeners(lst)
+    for _ in range(3):
+        net.fit(x, y)
+    assert lst._last_params is None                # no host param copy, ever
+    ups = storage.get_all_updates("flight_sess")
+    assert len(ups) == 3
+    ps, us = ups[-1].param_stats, ups[-1].update_stats
+    assert set(ps) == {"0:DenseLayer", "1:OutputLayer"}
+    assert ps["0:DenseLayer"]["norm"] > 0
+    assert us["0:DenseLayer"]["ratio"] > 0
+    assert us["1:OutputLayer"]["non_finite"] == 0.0
+
+
+def test_stats_listener_numpy_oracle_matches_recorder_path():
+    from deeplearning4j_tpu.ui.stats_listener import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    x, y = _data(4, 3, seed=12)
+    net = _mlp()
+    net.attach_flight_recorder(FlightRecorder(sample_every=1))
+    storage = InMemoryStatsStorage()
+    net.set_listeners(
+        StatsListener(storage, session_id="rec_sess"),
+        StatsListener(storage, session_id="np_sess", numpy_stats=True))
+    for _ in range(2):
+        net.fit(x, y)
+    rec_up = storage.get_all_updates("rec_sess")[-1]
+    np_up = storage.get_all_updates("np_sess")[-1]
+    # the numpy oracle reports per-leaf norms ("0:DenseLayer/W"); the
+    # recorder reports the per-layer group norm — they must agree as
+    # sqrt(sum of squared leaf norms)
+    for gname, stats in rec_up.param_stats.items():
+        leaf_sq = sum(v["norm"] ** 2 for k, v in np_up.param_stats.items()
+                      if k.startswith(gname + "/"))
+        assert np.isclose(stats["norm"], np.sqrt(leaf_sq), rtol=1e-4)
+    for gname, stats in rec_up.update_stats.items():
+        leaf_sq = sum(v["norm"] ** 2 for k, v in np_up.update_stats.items()
+                      if k.startswith(gname + "/"))
+        assert np.isclose(stats["norm"], np.sqrt(leaf_sq), rtol=1e-4)
+
+
+# ------------------------------------------------------- online provenance
+
+def test_online_post_step_quarantine_carries_layer_provenance(tmp_path):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.online import OnlineTrainer
+    from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
+
+    x, y = _data(4, 3, seed=13)
+    plain0 = _counter_value("dl4jtpu_online_quarantined_batches_total",
+                            reason="post_step_non_finite")
+
+    # an absurd LR diverges in two steps: step 1 blows params up (still
+    # finite), step 2's forward overflows → non-finite grads, which the
+    # recorder pins to its layer before the post-step score check fires
+    net = _mlp(updater=Sgd(1e15))
+    net.attach_flight_recorder(FlightRecorder(sample_every=1))
+    batches = iter([DataSet(x, y), DataSet(x, y)])
+    tr = OnlineTrainer(net, batches, CheckpointManager(tmp_path / "a"),
+                       batches_per_round=2)
+    assert tr.run_round() is None                  # rejected, no checkpoint
+    layer = net._flight.first_non_finite()["layer"]
+    assert layer in ("0:DenseLayer", "1:OutputLayer")
+    assert _counter_value("dl4jtpu_online_quarantined_batches_total",
+                          reason="post_step_non_finite") == plain0 + 1
+    assert _counter_value(
+        "dl4jtpu_online_quarantined_batches_total",
+        reason=f"post_step_non_finite:{layer}") >= 1
+
+    # without a recorder only the PLAIN label moves — existing consumers
+    # of {reason="post_step_non_finite"} see both runs
+    suffixed = _counter_value(
+        "dl4jtpu_online_quarantined_batches_total",
+        reason=f"post_step_non_finite:{layer}")
+    net2 = _mlp(updater=Sgd(1e15))
+    tr2 = OnlineTrainer(net2, iter([DataSet(x, y), DataSet(x, y)]),
+                        CheckpointManager(tmp_path / "b"),
+                        batches_per_round=2)
+    assert tr2.run_round() is None
+    assert _counter_value("dl4jtpu_online_quarantined_batches_total",
+                          reason="post_step_non_finite") == plain0 + 2
+    assert _counter_value(
+        "dl4jtpu_online_quarantined_batches_total",
+        reason=f"post_step_non_finite:{layer}") == suffixed
+
+
+# ------------------------------------------------------- HTTP + Perfetto
+
+def test_train_diagnostics_endpoint_and_404():
+    from deeplearning4j_tpu.serving import InferenceServer
+    x, y = _data(4, 3, seed=14)
+    net = _mlp()
+    rec = FlightRecorder(sample_every=1)
+    net.attach_flight_recorder(rec)
+    for _ in range(3):
+        net.fit(x, y)
+    srv = InferenceServer(net, port=0, flight_recorder=rec).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/train/diagnostics"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["layers"] == ["0:DenseLayer", "1:OutputLayer"]
+        assert doc["cols"] == list(STAT_COLS)
+        assert [r_["iteration"] for r_ in doc["records"]] == [0, 1, 2]
+        assert doc["first_non_finite"] is None
+    finally:
+        srv.stop()
+
+    bare = InferenceServer(_mlp(), port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{bare.port}/train/diagnostics",
+                timeout=10)
+        assert e.value.code == 404
+    finally:
+        bare.stop()
+
+
+def test_flight_counter_events_merge_into_fleet_trace():
+    x, y = _data(4, 3, seed=15)
+    net = _mlp()
+    rec = FlightRecorder(sample_every=1)
+    net.attach_flight_recorder(rec)
+    for _ in range(2):
+        net.fit(x, y)
+    diag = rec.diagnostics()
+    events = flight_counter_events(diag, pid="train-telemetry test")
+    assert events[0]["ph"] == "M"
+    counters = [e for e in events if e["ph"] == "C"]
+    assert len(counters) == len(diag["records"]) * len(STAT_COLS)
+    assert {e["name"] for e in counters} \
+        == {f"train/{c}" for c in STAT_COLS}
+    assert all(set(e["args"]) == {"0:DenseLayer", "1:OutputLayer"}
+               for e in counters)
+    merged = merge_docs([{"traceEvents": events}])
+    timed = [e for e in merged["traceEvents"] if "ts" in e
+             and e["ph"] != "M"]
+    assert min(e["ts"] for e in timed) == 0        # rebased timeline
+    assert any(e["ph"] == "M" for e in merged["traceEvents"])
