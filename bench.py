@@ -1388,6 +1388,147 @@ def bench_quantized(streams=16, gen_tokens=96, fast=False):
          "fast_variant": fast})
 
 
+def bench_spec_decode(fast=False):
+    """Speculative decoding row: greedy charRNN decode through the plain
+    engine vs draft/verify speculation at k in {2, 4}
+    (docs/DECODING.md "Speculative decoding"). The draft is a smaller
+    LSTM DISTILLED on the target's own greedy trajectories (teacher-
+    forced next-token fit until its argmax tracks the target's): a
+    random draft accepts ~1/vocab of its proposals and cannot pay for
+    its own forward, so the row first buys acceptance, then measures.
+
+    Asserted: every speculative output token-for-token the baseline
+    engine's (the lossless guarantee, both k), ONE step + ONE verify +
+    ONE draft program per spec engine, distilled acceptance rate above
+    floor; (full mode only) best spec tokens/sec ≥ 1.8x the
+    non-speculative engine. ``fast=True`` is the tier-1 CI variant
+    (tests/test_bench_rows.py): tiny widths and token counts, the
+    wall-clock ratio reported but not asserted — identity, compile pins
+    and the acceptance floor stay asserted."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import DecodeEngine
+    from deeplearning4j_tpu.serving.spec import SpecConfig
+
+    if fast:
+        vocab, width, dwidth = 13, 24, 12
+        streams, gen_tokens, max_len = 2, 8, 48
+        n_prompts, accept_floor = 2, 0.3
+    else:
+        vocab, width, dwidth = 77, 256, 64
+        streams, gen_tokens, max_len = 16, 96, 128
+        n_prompts, accept_floor = 4, 0.5
+    plen = 8
+
+    def lstm_lm(n_layers, w, seed):
+        b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+             .weight_init("xavier").list())
+        for _ in range(n_layers):
+            b = b.layer(LSTM(n_out=w, activation="tanh"))
+        return MultiLayerNetwork(
+            b.layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                   loss="mcxent"))
+            .set_input_type(InputType.recurrent(vocab)).build()).init()
+
+    net = lstm_lm(2, width, seed=23)          # the charRNN target
+    draft = lstm_lm(1, dwidth, seed=5)
+    rs = np.random.RandomState(29)
+    prompts = [[int(t) for t in rs.randint(0, vocab, plen)]
+               for _ in range(n_prompts)]
+
+    # --- greedy trajectories from the target, for distillation AND as
+    # the reference outputs the speculative engines must reproduce
+    base_eng = DecodeEngine(net, slots=streams, max_len=max_len)
+    base_eng.warmup()
+    base_eng.start()
+    try:
+        trajs = [prompts[i] + base_eng.generate(
+                     p, max_new_tokens=gen_tokens, timeout=600)["tokens"]
+                 for i, p in enumerate(prompts)]
+        # distill: teacher-forced next-token fit on the trajectories
+        eye = np.eye(vocab, dtype=np.float32)
+        x = np.stack([eye[t[:-1]] for t in trajs])
+        y = np.stack([eye[t[1:]] for t in trajs])
+        ds = DataSet(x, y)
+        agree = 0.0
+        for _ in range(60):
+            for _ in range(10):
+                draft.fit(ds)
+            out = np.asarray(draft.output(x))
+            agree = float(np.mean(np.argmax(out, -1) == np.argmax(y, -1)))
+            if agree >= 0.98:
+                break
+
+        # --- measurement: same traffic, baseline then spec k in {2, 4}
+        meas = (prompts * ((streams + n_prompts - 1) // n_prompts))[:streams]
+
+        def storm(eng):
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new_tokens=gen_tokens) for p in meas]
+            outs = [f.result(timeout=600)["tokens"] for f in futs]
+            return outs, sum(len(o) for o in outs) / (time.perf_counter() - t0)
+
+        base_eng.generate(prompts[0], max_new_tokens=4)   # steady-state
+        base_out, base_tps = storm(base_eng)
+        base_st = base_eng.stats()
+    finally:
+        base_eng.stop()
+
+    spec_tps, spec_rate, spec_st = {}, {}, {}
+    for k in (2, 4):
+        eng = DecodeEngine(net, slots=streams, max_len=max_len,
+                           spec=SpecConfig(draft, k=k))
+        eng.warmup()
+        eng.start()
+        try:
+            eng.generate(prompts[0], max_new_tokens=4)    # steady-state
+            out, tps = storm(eng)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        assert out == base_out, (
+            f"speculative k={k} output diverged from the plain engine")
+        assert st["compiled_programs"] == 1, st
+        assert st["spec"]["verify_programs"] == 1, st
+        assert st["spec"]["draft_programs"] == 1, st
+        spec_tps[k], spec_rate[k], spec_st[k] = tps, st["spec"], st
+    assert base_st["compiled_programs"] == 1, base_st
+    best_k = max(spec_tps, key=spec_tps.get)
+    speedup = spec_tps[best_k] / base_tps
+    for k in (2, 4):
+        assert spec_rate[k]["acceptance_rate"] >= accept_floor, (
+            f"distilled draft acceptance {spec_rate[k]['acceptance_rate']}"
+            f" at k={k} below {accept_floor} (trace agreement {agree:.3f})")
+    if not fast:
+        assert speedup >= 1.8, (
+            f"speculative decode {spec_tps[best_k]:.1f} tok/s is only "
+            f"{speedup:.2f}x the plain engine's {base_tps:.1f}")
+    return _emit(
+        f"speculative decode (charRNN 2xLSTM({width}) + distilled "
+        f"LSTM({dwidth}) draft, {streams} streams)", spec_tps[best_k],
+        "tokens/sec", BARS["decode"],
+        {"baseline_tokens_per_sec": round(base_tps, 1),
+         "spec_tokens_per_sec": {k: round(v, 1)
+                                 for k, v in spec_tps.items()},
+         "speedup_spec_vs_baseline": round(speedup, 2),
+         "best_k": best_k,
+         "acceptance_rate": {k: spec_rate[k]["acceptance_rate"]
+                             for k in (2, 4)},
+         "drafted_tokens": {k: spec_rate[k]["drafted_tokens"]
+                            for k in (2, 4)},
+         "accepted_tokens": {k: spec_rate[k]["accepted_tokens"]
+                             for k in (2, 4)},
+         "draft_trace_agreement": round(agree, 3),
+         "compiled_programs": [base_st["compiled_programs"]] +
+                              [spec_st[k]["compiled_programs"]
+                               for k in (2, 4)],
+         "outputs_token_identical": True,
+         "fast_variant": fast})
+
+
 def bench_ladder(n_req=384, max_batch=64, fast=False):
     """Measured bucket ladder vs blind pow2 (serving/engine.py autotune).
     The SAME mixed-size non-pow2 traffic runs through two engines: one on
@@ -2277,6 +2418,7 @@ BENCHES = {
     "kv_storm": bench_kv_storm,
     "kv_prefix": bench_kv_prefix,
     "quantized": bench_quantized,
+    "spec_decode": bench_spec_decode,
     "router": bench_router,
     "observability": bench_observability,
     "robustness": bench_robustness,
@@ -2301,6 +2443,7 @@ _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "parallelwrapper": 150, "sharded": 150, "word2vec": 120,
         "serving": 120, "ladder": 90, "quantized": 150,
         "decode": 150, "kv_storm": 120, "kv_prefix": 120,
+        "spec_decode": 180,
         "observability": 160, "robustness": 100,
         "router": 150, "online": 120, "train_perf": 150}
 

@@ -1062,10 +1062,13 @@ class ComputationGraph:
         return (outs[0] if len(outs) == 1 else outs), new_d
 
     def prefill_chunk(self, params, state, dstate, x, start, n,
-                      block_tables=None):
+                      block_tables=None, carry_stack=False):
         """Advance a prefill chunk along the topo order: ``x`` (B, K, F)
         chunk activations, ``n`` (B,) valid rows (Layer.prefill_chunk).
-        Vertices apply to the (B, K, F) chunk slices unchanged."""
+        Vertices apply to the (B, K, F) chunk slices unchanged.
+        ``carry_stack=True`` additionally returns a name-keyed dict of
+        carry snapshot stacks (None where the layer keeps no carry) for
+        speculative rewind (serving/spec/)."""
         if len(self.conf.network_inputs) != 1:
             raise ValueError(
                 "incremental decode supports single-input graphs; got "
@@ -1077,6 +1080,7 @@ class ComputationGraph:
             params = _cast_floats(params, cdt)
         acts = {self.conf.network_inputs[0]: x}
         new_d = dict(dstate)
+        stacks = {}
         for name in self.conf.topological_order:
             node = self.conf.nodes[name]
             if node.kind == "input":
@@ -1085,14 +1089,21 @@ class ComputationGraph:
             if node.kind == "vertex":
                 acts[name] = node.vertex.apply(ins)
                 continue
-            y, nd = node.layer.prefill_chunk(
-                params.get(name, {}), dstate.get(name), ins[0], start, n,
-                state=state.get(name) if state else None,
-                block_tables=block_tables)
+            st = state.get(name) if state else None
+            if carry_stack:
+                y, nd, stacks[name] = node.layer.prefill_chunk(
+                    params.get(name, {}), dstate.get(name), ins[0], start,
+                    n, state=st, block_tables=block_tables,
+                    carry_stack=True)
+            else:
+                y, nd = node.layer.prefill_chunk(
+                    params.get(name, {}), dstate.get(name), ins[0], start,
+                    n, state=st, block_tables=block_tables)
             new_d[name] = nd
             acts[name] = y
         outs = [acts[n] for n in self.conf.network_outputs]
-        return (outs[0] if len(outs) == 1 else outs), new_d
+        out = outs[0] if len(outs) == 1 else outs
+        return (out, new_d, stacks) if carry_stack else (out, new_d)
 
     def evaluate(self, data):
         """First-output classification eval, dispatched through the

@@ -998,22 +998,31 @@ class MultiLayerNetwork:
         return x, new_d
 
     def prefill_chunk(self, params, state, dstate, x, start, n,
-                      block_tables=None):
+                      block_tables=None, carry_stack=False):
         """Advance a prefill chunk through the stack: ``x`` (B, K, F)
         activations for positions ``start .. start+K-1`` per stream, ``n``
         (B,) valid rows (see Layer.prefill_chunk). Same compute-dtype
-        handling as ``decode_step``."""
+        handling as ``decode_step``. ``carry_stack=True`` additionally
+        returns a per-layer list of carry snapshot stacks (None where the
+        layer keeps no carry) for speculative rewind (serving/spec/)."""
         gc = self.conf.global_conf
         if gc.compute_dtype:
             cdt = _dtype_of(gc.compute_dtype)
             x = x.astype(cdt)
             params = _cast_floats(params, cdt)
         new_d = list(dstate)
+        stacks = [None] * len(self.layers)
         for i, l in enumerate(self.layers):
-            x, new_d[i] = l.prefill_chunk(params[i], dstate[i], x, start, n,
-                                          state=state[i] if state else None,
-                                          block_tables=block_tables)
-        return x, new_d
+            st = state[i] if state else None
+            if carry_stack:
+                x, new_d[i], stacks[i] = l.prefill_chunk(
+                    params[i], dstate[i], x, start, n, state=st,
+                    block_tables=block_tables, carry_stack=True)
+            else:
+                x, new_d[i] = l.prefill_chunk(params[i], dstate[i], x,
+                                              start, n, state=st,
+                                              block_tables=block_tables)
+        return (x, new_d, stacks) if carry_stack else (x, new_d)
 
     # ------------------------------------------------------------- evaluate
     def _eval_stream(self, data, eval_fn):

@@ -76,6 +76,12 @@ class MultiHeadAttention(Layer):
     causal: bool = False
     has_bias: bool = True
 
+    # KV caches are POSITIONAL decode state: rows are indexed by token
+    # position and guarded by the causal mask, so speculative rewind
+    # (serving/spec/) never snapshots them — rejected positions are
+    # simply overwritten before any read can reach them.
+    positional_state_keys = ("k", "v", "pk", "pv")
+
     def set_n_in(self, input_type):
         if self.n_in == 0:
             self.n_in = input_type.size or input_type.flat_size()
@@ -262,35 +268,61 @@ class MultiHeadAttention(Layer):
                 {"pk": pk, "pv": pv})
 
     def prefill_chunk(self, params, dstate, x, start, n, state=None,
-                      block_tables=None):
-        """Chunked prefill against the block pool: scatter the chunk's K
-        rows of KV into their pool positions, gather the logical cache,
-        and run the same causal-masked softmax/gemm the full forward runs
-        — bitwise-equal to teacher forcing row-for-row (the (K, C) gemm's
-        rows are independent, like the decode trick's 2-row gemm). Rows
-        past a slot's ``n`` scatter into the scratch block and produce
-        garbage activations the engine discards."""
-        if dstate is None or "pk" not in dstate:
+                      block_tables=None, carry_stack=False):
+        """Chunked prefill: scatter the chunk's K rows of KV into their
+        cache positions, gather the logical cache, and run the same
+        causal-masked softmax/gemm the full forward runs — bitwise-equal
+        to teacher forcing row-for-row (the (K, C) gemm's rows are
+        independent, like the decode trick's 2-row gemm).
+
+        Paged (``"pk"`` in dstate): rows past a slot's ``n`` scatter into
+        the scratch block and produce garbage activations the engine
+        discards. Dense: the cache is updated with a position-aligned
+        gather+where instead of a scatter, so padding rows (whose clipped
+        positions could collide with real writes) are masked out
+        deterministically. ``carry_stack`` always returns a None stack —
+        KV state is positional, never snapshotted (see Layer)."""
+        if dstate is None:
             return super().prefill_chunk(params, dstate, x, start, n,
                                          state=state,
-                                         block_tables=block_tables)
+                                         block_tables=block_tables,
+                                         carry_stack=carry_stack)
         B, K, _ = x.shape
         q, k, v = self._project(params, x)              # (B, K, H, Dh)
-        bs = dstate["pk"].shape[1]
-        MB = block_tables.shape[1]
-        C = MB * bs
         poss = start[:, None] + jnp.arange(K)[None, :]  # (B, K) positions
         valid = jnp.arange(K)[None, :] < n[:, None]
         rows = jnp.arange(B)
-        bidx = jnp.clip(poss // bs, 0, MB - 1)
-        phys = jnp.where(valid, block_tables[rows[:, None], bidx], 0)
-        off = poss % bs
-        pk = dstate["pk"].at[phys, off].set(k)
-        pv = dstate["pv"].at[phys, off].set(v)
-        # gather AFTER the scatter: chunk rows attend causally to rows
-        # written in this same chunk, exactly like teacher forcing
-        kc = pk[block_tables].reshape(B, C, *pk.shape[2:])
-        vc = pv[block_tables].reshape(B, C, *pv.shape[2:])
+        if "pk" in dstate:
+            bs = dstate["pk"].shape[1]
+            MB = block_tables.shape[1]
+            C = MB * bs
+            bidx = jnp.clip(poss // bs, 0, MB - 1)
+            phys = jnp.where(valid, block_tables[rows[:, None], bidx], 0)
+            off = poss % bs
+            pk = dstate["pk"].at[phys, off].set(k)
+            pv = dstate["pv"].at[phys, off].set(v)
+            # gather AFTER the scatter: chunk rows attend causally to rows
+            # written in this same chunk, exactly like teacher forcing
+            kc = pk[block_tables].reshape(B, C, *pk.shape[2:])
+            vc = pv[block_tables].reshape(B, C, *pv.shape[2:])
+            nd = {"pk": pk, "pv": pv}
+        else:
+            C = dstate["k"].shape[1]
+            # position-aligned update: cache position c takes chunk row
+            # c - start when that row is valid, else keeps its old value
+            coff = jnp.arange(C)[None, :] - start[:, None]       # (B, C)
+            wr = (coff >= 0) & (coff < jnp.minimum(n, K)[:, None])
+            tidx = jnp.broadcast_to(
+                jnp.clip(coff, 0, K - 1)[:, :, None, None],
+                (B, C) + k.shape[2:])
+
+            def upd(cache, new):
+                g = jnp.take_along_axis(new, tidx, axis=1)
+                return jnp.where(wr[:, :, None, None], g, cache)
+
+            kc = upd(dstate["k"], k)
+            vc = upd(dstate["v"], v)
+            nd = {"k": kc, "v": vc}
         scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale   # (B, H, K, C)
         causal = jnp.arange(C)[None, None, :] <= poss[:, :, None]
@@ -304,7 +336,7 @@ class MultiHeadAttention(Layer):
         o = o.reshape(B, K, self.n_out) @ params["Wo"]
         if self.has_bias:
             o = o + params["bo"]
-        return o, {"pk": pk, "pv": pv}
+        return (o, nd, None) if carry_stack else (o, nd)
 
 
 @register_layer
@@ -363,10 +395,11 @@ class PositionalEmbedding(Layer):
         return x + params["P"][pos][:, None, :], dstate
 
     def prefill_chunk(self, params, dstate, x, start, n, state=None,
-                      block_tables=None):
+                      block_tables=None, carry_stack=False):
         """Chunk rows sit at global positions ``start + t``, not ``t`` —
         the stateless default's ``apply`` would add P[0:K]."""
         K = x.shape[1]
         poss = start[:, None] + jnp.arange(K)[None, :]   # (B, K)
         poss = jnp.clip(poss, 0, self.max_len - 1)
-        return x + params["P"][poss], dstate
+        y = x + params["P"][poss]
+        return (y, dstate, None) if carry_stack else (y, dstate)

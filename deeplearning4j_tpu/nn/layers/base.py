@@ -96,6 +96,15 @@ class Layer:
     # stack becomes a fixed-shape (B, 1, F) → (B, 1, F) step the containers
     # can jit exactly once. Stateless layers (dense, norm, activations)
     # inherit these defaults: no state, apply() on the length-1 slice.
+
+    # Decode-state dict keys that are POSITIONAL: written at an explicit
+    # position index (attention KV caches), so speculative rewind
+    # (serving/spec/) can leave over-written positions in place and rely
+    # on the causal position mask — only NON-positional leaves (recurrent
+    # carries) need snapshot/rollback. Plain class attribute, not a
+    # dataclass field.
+    positional_state_keys = ()
+
     def init_decode_state(self, params, batch: int, max_len: int,
                           dtype=jnp.float32):
         """Per-slot decode state for a batch of ``batch`` concurrent
@@ -134,7 +143,7 @@ class Layer:
         return self.decode_step(params, dstate, x, pos, state=state)
 
     def prefill_chunk(self, params, dstate, x, start, n, state=None,
-                      block_tables=None):
+                      block_tables=None, carry_stack=False):
         """Advance a chunk of prefill positions in one call. ``x``:
         (B, K, F) activations for positions ``start .. start+K-1`` per
         stream; ``n``: (B,) int32 valid rows (rows t >= n[b] are padding —
@@ -144,10 +153,17 @@ class Layer:
         Default: stateless layers apply() the whole chunk (timestep-wise
         ops make this the full-forward math); stateful layers advance
         their carry by scanning ``decode_step`` with a per-row valid mask
-        — bitwise the same trajectory a token-at-a-time prefill walks."""
+        — bitwise the same trajectory a token-at-a-time prefill walks.
+
+        ``carry_stack=True`` returns ``(y, new_dstate, snapshots)`` where
+        ``snapshots`` stacks the carry after EVERY chunk position along a
+        leading (K, ...) axis (None for stateless layers and layers whose
+        state is positional — ``positional_state_keys``). The speculative
+        verify program (serving/spec/verify.py) rewinds a slot to the
+        carry after its accepted prefix by selecting into this stack."""
         if dstate is None:
             y, _ = self.apply(params, x, state, train=False, rng=None)
-            return y, dstate
+            return (y, dstate, None) if carry_stack else (y, dstate)
         B, K = x.shape[0], x.shape[1]
         xs = jnp.moveaxis(x, 1, 0)[:, :, None, :]       # (K, B, 1, F)
 
@@ -160,8 +176,11 @@ class Layer:
                 return jnp.where(v.reshape((B,) + (1,) * (a.ndim - 1)), a, b)
 
             nd = jax.tree_util.tree_map(keep, nd, d)
-            return nd, y
+            return nd, ((y, nd) if carry_stack else y)
 
+        if carry_stack:
+            d, (ys, snaps) = jax.lax.scan(step, dstate, (xs, jnp.arange(K)))
+            return jnp.moveaxis(ys[:, :, 0, :], 0, 1), d, snaps
         d, ys = jax.lax.scan(step, dstate, (xs, jnp.arange(K)))
         return jnp.moveaxis(ys[:, :, 0, :], 0, 1), d
 

@@ -55,6 +55,9 @@ from deeplearning4j_tpu.serving.engine import (_tree_signature,
 from deeplearning4j_tpu.serving.kv import (BlockPool, PoolExhaustedError,
                                            PrefixCache, blocks_for_span,
                                            map_pool_leaves, map_slot_leaves)
+from deeplearning4j_tpu.serving.spec.accept import oracle_token, oracle_tokens
+from deeplearning4j_tpu.serving.spec.draft import DraftEngine
+from deeplearning4j_tpu.serving.spec.verify import SpecVerifier
 
 
 class _Request:
@@ -62,7 +65,7 @@ class _Request:
 
     __slots__ = ("prompt", "max_new", "seed", "temperature", "top_k",
                  "cursor", "generated", "future", "fresh", "t_start",
-                 "kv_blocks")
+                 "kv_blocks", "draft_cursor", "draft_sel", "draft_fresh")
 
     def __init__(self, prompt, max_new, seed, temperature, top_k, future):
         self.prompt = list(prompt)
@@ -76,6 +79,11 @@ class _Request:
         self.fresh = True        # first step must wipe the slot's state
         self.t_start = time.perf_counter()
         self.kv_blocks: List[int] = []   # paged engines: claimed pool blocks
+        # speculative engines: the draft model's own progress through this
+        # stream (it prefills the prompt independently of the target)
+        self.draft_cursor = 0    # next input position the DRAFT will feed
+        self.draft_sel = 0       # snapshot stack index to resume carries at
+        self.draft_fresh = True  # first draft call must wipe the draft slot
 
 
 class DecodeEngine:
@@ -105,6 +113,11 @@ class DecodeEngine:
     (split prefill into chunks of this many tokens that ride the batched
     iteration cadence next to live decode slots, instead of occupying one
     decode step per prompt token).
+    ``spec``: a ``serving.spec.SpecConfig(draft_model, k)`` switches the
+    scheduler to speculative decoding — a tiny draft proposes k tokens
+    per tick and the target verifies them in one batched step, emitting
+    1..k tokens per tick while staying bitwise-identical to the
+    non-speculative engine (docs/DECODING.md "Speculative decoding").
     """
 
     _ids = itertools.count()
@@ -114,7 +127,8 @@ class DecodeEngine:
                  precision: Optional[str] = None, kv: str = "dense",
                  kv_block_size: int = 16, kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
-                 chunk_tokens: Optional[int] = None):
+                 chunk_tokens: Optional[int] = None,
+                 spec=None):
         self.model = model
         self.slots = int(slots)
         self.max_len = int(max_len)
@@ -148,6 +162,21 @@ class DecodeEngine:
                  else model.conf.input_type)
         self.vocab = itype.size
         self.warmup_seconds: Optional[float] = None
+        self._spec = spec
+        if spec is not None:
+            # the draft proposes TOKEN IDS the target verifies — only
+            # meaningful over the exact same vocabulary
+            if int(spec.k) < 1:
+                raise ValueError(f"spec.k must be >= 1, got {spec.k}")
+            dm = spec.draft_model
+            ditype = (dm.conf.input_types[0]
+                      if hasattr(dm.conf, "network_inputs")
+                      else dm.conf.input_type)
+            if ditype.size != self.vocab:
+                raise ValueError(
+                    f"draft model vocabulary ({ditype.size}) must match "
+                    f"the target's ({self.vocab})")
+            self._spec_k = int(spec.k)
 
         from deeplearning4j_tpu import exec as ex
         execu = getattr(model, "_executor", None) or ex.get_executor()
@@ -307,6 +336,38 @@ class DecodeEngine:
                 "Prompt tokens prefilled through the chunked-prefill "
                 "program.", ("engine",)).labels(**lab)
 
+        self._verifier = None
+        self._draft = None
+        if spec is not None:
+            self._verifier = SpecVerifier(
+                self.model, self.id, self.slots, self.max_len,
+                self._spec_k, self.vocab, kv=self.kv,
+                kv_max_blocks=self.kv_max_blocks)
+            self._draft = DraftEngine(
+                spec.draft_model, self.id, self.slots, self.max_len,
+                self._spec_k, self.vocab,
+                precision=spec.draft_precision)
+            self._m_spec_drafted = reg.counter(
+                "dl4jtpu_spec_drafted_tokens_total",
+                "Tokens proposed by the speculative draft model.",
+                ("engine",)).labels(**lab)
+            self._m_spec_accepted = reg.counter(
+                "dl4jtpu_spec_accepted_tokens_total",
+                "Drafted tokens accepted by target verification "
+                "(exact-match against the sampling oracle).",
+                ("engine",)).labels(**lab)
+            self._m_spec_rate = reg.gauge(
+                "dl4jtpu_spec_acceptance_rate",
+                "Lifetime accepted/drafted ratio — the draft-quality "
+                "signal that decides whether speculation pays.",
+                ("engine",)).labels(**lab)
+            self._m_spec_draft_seconds = reg.histogram(
+                "dl4jtpu_spec_draft_step_seconds",
+                "Wall seconds of one k-token draft-model call (compare "
+                "against dl4jtpu_decode_token_seconds: speculation wins "
+                "while draft cost + one verify < k target steps).",
+                ("engine",)).labels(**lab)
+
     @property
     def trace_count(self) -> int:
         return int(self._m_compiled.value)
@@ -444,23 +505,12 @@ class DecodeEngine:
             y, new_d = self.model.decode_step(params, state, dstate, x, pos,
                                               block_tables=btab)
 
-        probs = y[:, 0, :]
-        logits = jnp.log(probs)      # output layer emits probs; log is
-        V = logits.shape[-1]         # monotone so sampling is equivalent
-        k = jnp.where(topk > 0, jnp.clip(topk, 1, V), V)
-        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-        thr = jnp.take_along_axis(sorted_l, (k - 1)[:, None], axis=-1)
-        logits = jnp.where(logits >= thr, logits, -jnp.inf)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        safe_t = jnp.where(temps > 0, temps, 1.0).astype(logits.dtype)
-
-        def sample(seed, p, row):
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), p)
-            return jax.random.categorical(key, row)
-
-        sampled = jax.vmap(sample)(seeds, pos,
-                                   logits / safe_t[:, None]).astype(jnp.int32)
-        next_tok = jnp.where(temps > 0, sampled, greedy)
+        # ONE sampling rule for the whole codebase: generate_naive and the
+        # speculative verify program (serving/spec/) call the same oracle,
+        # so every path emits bitwise-identical tokens for the same
+        # (distribution, seed, position). log(probs) is monotone, so
+        # top-k filtering and argmax are equivalent on either scale.
+        next_tok = oracle_tokens(jnp.log(y[:, 0, :]), seeds, pos, temps, topk)
         next_tok = jnp.where(active, next_tok, 0)
 
         def freeze(new, old):
@@ -530,6 +580,8 @@ class DecodeEngine:
             else:
                 self._dstate = self.model.init_decode_state(self.slots,
                                                             self.max_len)
+        if self._draft is not None:
+            self._draft.ensure_state()
 
     def start(self) -> "DecodeEngine":
         self._ensure_dstate()
@@ -604,6 +656,20 @@ class DecodeEngine:
         if self._cow is not None:
             self._dstate = self._cow(self._dstate, np.zeros(1, np.int32),
                                      np.zeros(1, np.int32))
+        if self._spec is not None:
+            # the draft and verify programs compile here too: an
+            # all-inert draft tick and an all-inert verify (n_in == 0
+            # everywhere) leave both state trees bitwise intact
+            K = self._spec_k
+            zk = np.zeros((S, K), np.int32)
+            u, fl = np.zeros(S, np.uint32), np.zeros(S, np.float32)
+            self._draft.step(zk, z, z, z, z, f, u, fl, z)
+            vargs = (zk, zk, z, z, f, u, fl, z)
+            if self.kv == "paged":
+                vargs = (np.zeros((S, self.kv_max_blocks), np.int32),
+                         ) + vargs
+            _, _, _, self._dstate = self._verifier.run(
+                params, state, self._dstate, *vargs)
         jax.block_until_ready(self._dstate)
         self.warmup_seconds = time.perf_counter() - t0
         if self._m_compiled.value > c0:
@@ -800,6 +866,9 @@ class DecodeEngine:
                         if r.cursor >= len(r.prompt) - 1]
                 if not live:
                     continue
+            if self._spec is not None:
+                self._tick_spec(live, params, state)
+                continue
             tokens = np.zeros(S, np.int32)
             pos = np.zeros(S, np.int32)
             reset = np.zeros(S, bool)
@@ -863,6 +932,189 @@ class DecodeEngine:
                                      "prompt_len": len(r.prompt)})
         self._m_occupancy.set(0)
 
+    # ------------------------------------------------------- speculative tick
+    def _tick_spec(self, live, params, state):
+        """One speculative scheduler iteration. At most THREE device calls
+        regardless of slot mix, each a fixed-shape compiled-once program:
+
+        1. one DRAFT call — prompt catch-up rows (the draft prefills the
+           prompt independently, up to k positions per tick) and ready
+           generation rows (propose k tokens) share it, masks not shapes;
+        2. one target STEP — rows still consuming their prompt through
+           the plain path (no chunked prefill) ride the ordinary step
+           program with its sampled output ignored;
+        3. one VERIFY — every ready row's k-token window in one batched
+           multi-position target step; the host appends the oracle's
+           emitted prefix (1..k tokens per slot per tick).
+
+        A row is 'ready' once the draft has caught up to the target
+        cursor; a fresh slot becomes ready after ceil((plen-1)/k) draft
+        ticks, which overlap the target's own prefill steps."""
+        S, K = self.slots, self._spec_k
+        catchup, ready, tpre = [], [], []
+        for i, r in live:
+            plen = len(r.prompt)
+            if r.cursor < plen - 1:
+                tpre.append((i, r))
+            if r.draft_cursor < plen - 1:
+                catchup.append((i, r))
+            elif r.cursor >= plen - 1 and r.draft_cursor == r.cursor:
+                # the window may not outrun the request budget or the KV
+                # capacity — same write bound as the plain path
+                n_in = min(K, r.max_new - len(r.generated),
+                           self.max_len - r.cursor)
+                if n_in > 0:
+                    ready.append((i, r, n_in))
+        dprops = None
+        if catchup or ready:
+            given = np.zeros((S, K), np.int32)
+            n_given = np.zeros(S, np.int32)
+            n_steps = np.zeros(S, np.int32)
+            dpos = np.zeros(S, np.int32)
+            sel = np.zeros(S, np.int32)
+            dreset = np.zeros(S, bool)
+            dseeds = np.zeros(S, np.uint32)
+            dtemps = np.zeros(S, np.float32)
+            dtopk = np.zeros(S, np.int32)
+            for i, r in catchup:
+                m = min(K, len(r.prompt) - 1 - r.draft_cursor)
+                given[i, :m] = r.prompt[r.draft_cursor:r.draft_cursor + m]
+                n_given[i] = m
+                n_steps[i] = m
+                dpos[i] = r.draft_cursor
+                sel[i] = r.draft_sel
+                dreset[i] = r.draft_fresh
+                r.draft_fresh = False
+                r.draft_cursor += m
+                r.draft_sel = m - 1
+            for i, r, n_in in ready:
+                p = r.cursor
+                given[i, 0] = (r.prompt[p] if p < len(r.prompt)
+                               else r.generated[-1])
+                n_given[i] = 1
+                n_steps[i] = n_in
+                dpos[i] = p
+                sel[i] = r.draft_sel
+                dreset[i] = r.draft_fresh
+                r.draft_fresh = False
+                dseeds[i] = r.seed & 0xFFFFFFFF
+                dtemps[i] = r.temperature
+                dtopk[i] = r.top_k
+            t0 = time.perf_counter()
+            with trace.span("spec_draft", rows=len(catchup) + len(ready)):
+                dprops = self._draft.step(given, n_given, n_steps, dpos,
+                                          sel, dreset, dseeds, dtemps,
+                                          dtopk)
+            self._m_spec_draft_seconds.observe(time.perf_counter() - t0)
+        if tpre:
+            # plain-path prompt consumption rides the ordinary step
+            # program (the sampled token is ignored mid-prompt, exactly
+            # as in the non-speculative loop)
+            tokens = np.zeros(S, np.int32)
+            pos = np.zeros(S, np.int32)
+            reset = np.zeros(S, bool)
+            active = np.zeros(S, bool)
+            seeds = np.zeros(S, np.uint32)
+            temps = np.zeros(S, np.float32)
+            topk = np.zeros(S, np.int32)
+            for i, r in tpre:
+                active[i] = True
+                reset[i] = r.fresh
+                r.fresh = False
+                tokens[i] = r.prompt[r.cursor]
+                pos[i] = r.cursor
+                seeds[i] = r.seed & 0xFFFFFFFF
+                temps[i] = r.temperature
+                topk[i] = r.top_k
+            t0 = time.perf_counter()
+            c0 = self._m_compiled.value
+            step_args = (tokens, pos, reset, active, seeds, temps, topk)
+            if self._pool is not None:
+                btab = np.where(active[:, None], self._tables, 0)
+                step_args = (jnp.asarray(btab.astype(np.int32)),) + step_args
+            with trace.span("decode_step", active=len(tpre)):
+                _, self._dstate = self._step(params, state, self._dstate,
+                                             *step_args)
+            dt = time.perf_counter() - t0
+            if self._m_compiled.value > c0:
+                self._register_program(params, state, step_args, dt)
+            self._decode_seconds += dt
+            self._m_steps.inc()
+            for i, r in tpre:
+                r.cursor += 1
+        done = []
+        if ready:
+            vtok = np.zeros((S, K), np.int32)
+            vdraft = np.zeros((S, K), np.int32)
+            vpos = np.zeros(S, np.int32)
+            vn = np.zeros(S, np.int32)
+            vreset = np.zeros(S, bool)
+            vseeds = np.zeros(S, np.uint32)
+            vtemps = np.zeros(S, np.float32)
+            vtopk = np.zeros(S, np.int32)
+            for i, r, n_in in ready:
+                # window fed to the target: the last emitted (or final
+                # prompt) token, then the first n_in-1 proposals — the
+                # proposal at position t is judged against the oracle
+                # computed from the distribution AT t
+                vtok[i, 0] = given[i, 0]
+                vtok[i, 1:n_in] = dprops[i, :n_in - 1]
+                vdraft[i, :n_in] = dprops[i, :n_in]
+                vpos[i] = r.cursor
+                vn[i] = n_in
+                vreset[i] = r.fresh
+                r.fresh = False
+                vseeds[i] = r.seed & 0xFFFFFFFF
+                vtemps[i] = r.temperature
+                vtopk[i] = r.top_k
+            vargs = (vtok, vdraft, vpos, vn, vreset, vseeds, vtemps, vtopk)
+            if self._pool is not None:
+                vlive = vn > 0
+                btab = np.where(vlive[:, None], self._tables, 0)
+                vargs = (jnp.asarray(btab.astype(np.int32)),) + vargs
+            t0 = time.perf_counter()
+            with trace.span("spec_verify", rows=len(ready)):
+                oracle, acc, emit, self._dstate = self._verifier.run(
+                    params, state, self._dstate, *vargs)
+            dt = time.perf_counter() - t0
+            self._decode_seconds += dt
+            self._m_steps.inc()
+            self._m_token_seconds.observe(dt)
+            drafted = accepted = 0
+            for i, r, n_in in ready:
+                drafted += n_in
+                accepted += int(acc[i])
+                consumed, finished = 0, False
+                for j in range(int(emit[i])):
+                    tok = int(oracle[i, j])
+                    r.generated.append(tok)
+                    self._m_tokens.inc()
+                    consumed += 1
+                    if ((self.eos_id is not None and tok == self.eos_id)
+                            or len(r.generated) >= r.max_new
+                            or r.cursor + consumed >= self.max_len):
+                        finished = True
+                        break
+                r.cursor += consumed
+                r.draft_cursor += consumed
+                r.draft_sel = max(consumed - 1, 0)
+                if finished:
+                    done.append((i, r))
+            self._m_spec_drafted.inc(drafted)
+            self._m_spec_accepted.inc(accepted)
+            tot = self._m_spec_drafted.value
+            self._m_spec_rate.set(
+                self._m_spec_accepted.value / tot if tot else 0.0)
+        self._m_occupancy.set(len(live))
+        for i, r in done:
+            if self._pool is not None:
+                self._release_kv(i, r)
+            with self._cv:
+                self._slot_reqs[i] = None    # freed; wiped on re-claim
+            self._m_requests.inc()
+            r.future.set_result({"tokens": r.generated,
+                                 "prompt_len": len(r.prompt)})
+
     # --------------------------------------------------------------- stats
     def stats(self) -> dict:
         with self._cv:
@@ -883,8 +1135,21 @@ class DecodeEngine:
                 "prefill_tokens": int(self._m_prefill_tokens.value),
                 "exhausted_events": int(self._m_kv_exhausted.value),
             })
+        spec = None
+        if self._spec is not None:
+            drafted = int(self._m_spec_drafted.value)
+            accepted = int(self._m_spec_accepted.value)
+            spec = {"k": self._spec_k,
+                    "draft_precision": self._draft.precision,
+                    "drafted_tokens": drafted,
+                    "accepted_tokens": accepted,
+                    "acceptance_rate": (accepted / drafted if drafted
+                                        else 0.0),
+                    "verify_programs": self._verifier.programs,
+                    "draft_programs": self._draft.programs}
         return {"id": self.id,
                 "kv": kv,
+                "spec": spec,
                 "slots": self.slots,
                 "max_len": self.max_len,
                 "precision": self.precision,
@@ -925,16 +1190,10 @@ def generate_naive(model, prompt: Sequence[int], max_new_tokens: int,
             else:
                 probs, _, _ = model._forward(params, state, x,
                                              train=False, rng=None)
-            logits = jnp.log(probs[0, last])
-            V = logits.shape[-1]
-            k = jnp.where(tk > 0, jnp.clip(tk, 1, V), V)
-            thr = jnp.sort(logits)[::-1][k - 1]
-            logits = jnp.where(logits >= thr, logits, -jnp.inf)
-            greedy = jnp.argmax(logits).astype(jnp.int32)
-            rk = jax.random.fold_in(jax.random.PRNGKey(seed_), last)
-            safe_t = jnp.where(temp > 0, temp, 1.0).astype(logits.dtype)
-            sampled = jax.random.categorical(rk, logits / safe_t)
-            return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+            # same oracle as DecodeEngine._step_impl and the speculative
+            # verify program — one sampling rule, serving/spec/accept.py
+            return oracle_token(jnp.log(probs[0, last]), seed_, last,
+                                temp, tk)
 
         step = _cache[key] = jax.jit(step)
 

@@ -66,7 +66,20 @@ def build_model(name: str):
                 .set_input_type(InputType.recurrent(CHAR_VOCAB))
                 .build())
         return MultiLayerNetwork(conf).init()
-    raise ValueError(f"unknown replica model {name!r} (mlp | charlstm)")
+    if name == "charlstm-draft":
+        # the speculative draft for charlstm: same vocabulary, one narrow
+        # LSTM — a draft step must cost a fraction of a target step, and
+        # the seed differs so draft/target never share weights
+        conf = (NeuralNetConfiguration.builder().seed(17).updater(Adam(1e-2))
+                .weight_init("xavier").list()
+                .layer(LSTM(n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=CHAR_VOCAB, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(CHAR_VOCAB))
+                .build())
+        return MultiLayerNetwork(conf).init()
+    raise ValueError(
+        f"unknown replica model {name!r} (mlp | charlstm | charlstm-draft)")
 
 
 def build_server(model_name: str = "charlstm", port: int = 0,
@@ -75,7 +88,8 @@ def build_server(model_name: str = "charlstm", port: int = 0,
                  precision: Optional[str] = None, kv: str = "dense",
                  kv_block_size: int = 16, kv_blocks: Optional[int] = None,
                  prefix_cache: bool = False,
-                 chunk_tokens: Optional[int] = None):
+                 chunk_tokens: Optional[int] = None,
+                 spec_draft: Optional[str] = None, spec_k: int = 4):
     """Assemble (but don't start) a replica InferenceServer. ``charlstm``
     serves both /predict and /generate; ``mlp`` is predict-only.
     ``precision`` (None = the executor policy / DL4JTPU_PRECISION) puts
@@ -86,7 +100,10 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     ``chunk_tokens`` select the paged KV cache for the decode engine
     (docs/DECODING.md "Paged KV"); ``prefix_cache`` defaults off here
     because the stock charlstm carries recurrent decode state, which the
-    prefix cache cannot share."""
+    prefix cache cannot share. ``spec_draft`` names a draft model (e.g.
+    ``charlstm-draft``) to switch /generate to speculative decoding with
+    ``spec_k`` tokens proposed per tick (docs/DECODING.md "Speculative
+    decoding"); output stays bitwise-identical to the plain engine."""
     from deeplearning4j_tpu.serving.decode import DecodeEngine
     from deeplearning4j_tpu.serving.engine import InferenceEngine
     from deeplearning4j_tpu.serving.server import InferenceServer
@@ -94,11 +111,15 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     eng = InferenceEngine(net, precision=precision)
     dec = None
     if model_name == "charlstm":
+        spec = None
+        if spec_draft is not None:
+            from deeplearning4j_tpu.serving.spec import SpecConfig
+            spec = SpecConfig(build_model(spec_draft), k=spec_k)
         dec = DecodeEngine(net, slots=slots, max_len=max_len,
                            max_queue=max_queue, precision=precision,
                            kv=kv, kv_block_size=kv_block_size,
                            kv_blocks=kv_blocks, prefix_cache=prefix_cache,
-                           chunk_tokens=chunk_tokens)
+                           chunk_tokens=chunk_tokens, spec=spec)
     injector = None
     if chaos:
         from deeplearning4j_tpu.resilience.faults import ServerFaultInjector
@@ -153,6 +174,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--chunk-tokens", type=int, default=None,
                         help="split prefill into chunks of this many tokens "
                              "riding the batched decode cadence (paged only)")
+    parser.add_argument("--spec-draft", default=None,
+                        choices=("charlstm-draft",),
+                        help="speculative decoding: draft model name for "
+                             "the decode engine (lossless — output is "
+                             "bitwise the non-speculative stream)")
+    parser.add_argument("--spec-k", type=int, default=4,
+                        help="tokens the draft proposes per tick "
+                             "(with --spec-draft)")
     args = parser.parse_args(argv)
 
     # CPU platform before anything touches a backend: replicas are test
@@ -174,7 +203,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                        kv_block_size=args.kv_block_size,
                        kv_blocks=args.kv_blocks,
                        prefix_cache=args.prefix_cache,
-                       chunk_tokens=args.chunk_tokens)
+                       chunk_tokens=args.chunk_tokens,
+                       spec_draft=args.spec_draft, spec_k=args.spec_k)
     if srv.decode_engine is not None:
         srv.decode_engine.start()
         if args.warmup:
@@ -246,7 +276,8 @@ class ReplicaProcess:
                  precision: Optional[str] = None, trace: bool = False,
                  kv: str = "dense", kv_block_size: int = 16,
                  kv_blocks: Optional[int] = None, prefix_cache: bool = False,
-                 chunk_tokens: Optional[int] = None):
+                 chunk_tokens: Optional[int] = None,
+                 spec_draft: Optional[str] = None, spec_k: int = 4):
         self.workdir = workdir
         self.model = model
         self.slots = slots
@@ -260,6 +291,8 @@ class ReplicaProcess:
         self.kv_blocks = kv_blocks
         self.prefix_cache = prefix_cache
         self.chunk_tokens = chunk_tokens
+        self.spec_draft = spec_draft
+        self.spec_k = spec_k
         # span tracing in the child (GET /trace serves its ring buffer)
         self.trace = trace
         # mutable: rolling restarts set this to the latest promoted
@@ -301,6 +334,9 @@ class ReplicaProcess:
                 cmd.append("--prefix-cache")
             if self.chunk_tokens is not None:
                 cmd.extend(["--chunk-tokens", str(self.chunk_tokens)])
+        if self.spec_draft is not None:
+            cmd.extend(["--spec-draft", self.spec_draft,
+                        "--spec-k", str(self.spec_k)])
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = (_repo_root() + os.pathsep

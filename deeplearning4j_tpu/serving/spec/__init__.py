@@ -1,0 +1,63 @@
+"""Speculative decoding subsystem: draft/verify serving acceleration.
+
+Autoregressive decode is latency-bound, not compute-bound: every token
+costs one full forward of the target model, and the accelerator idles on
+weight bandwidth while the host round-trips. Speculative decoding
+(Leviathan et al. 2023; Chen et al. 2023) breaks the one-token-per-
+forward barrier: a tiny DRAFT model proposes k tokens per tick, the
+target VERIFIES all k in one batched multi-position step (k positions
+through one program costs barely more than one), and an acceptance rule
+keeps the emitted stream exactly the target's own distribution — here
+in its strongest form: bitwise-identical to the non-speculative engine
+for greedy AND seeded temperature sampling, because draft, verify and
+the plain step all share one sampling oracle (accept.py).
+
+Wiring (``DecodeEngine(spec=SpecConfig(draft_model, k))``):
+
+- ``accept.py`` — ``oracle_token`` (the engine sampling rule, also used
+  by the non-speculative step and ``generate_naive``) and
+  ``accept_length`` (leading-match acceptance + correction token).
+- ``draft.py``  — slot-aligned k-step draft scan, one donated compiled
+  program, carry snapshot stacks for rewind, optional int8/fp8 weights.
+- ``verify.py`` — one batched target step over each slot's k-token
+  window through the chunked-prefill write path; rejected positions are
+  causally masked until overwritten, carries roll back via snapshots.
+- ``rewind.py`` — carry-vs-positional state classification and rollback
+  (``Layer.positional_state_keys``).
+
+Scheduling stays data-not-shapes: per tick the engine issues at most one
+draft call, one (prefill) step and one verify, each a fixed-(S, k) shape
+program compiled exactly once regardless of arrival schedule — the same
+trace-count pins the plain decode path enforces. See docs/DECODING.md
+"Speculative decoding".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from deeplearning4j_tpu.serving.spec.accept import (accept_length,
+                                                    oracle_token,
+                                                    oracle_tokens)
+from deeplearning4j_tpu.serving.spec.draft import DraftEngine
+from deeplearning4j_tpu.serving.spec.verify import SpecVerifier
+
+
+@dataclass
+class SpecConfig:
+    """Speculative decoding knobs for ``DecodeEngine(spec=...)``.
+
+    ``draft_model``: a model container (MultiLayerNetwork /
+    ComputationGraph) implementing the incremental-decode protocol over
+    the SAME vocabulary as the target. ``k``: tokens proposed per tick —
+    tuning table in docs/DECODING.md. ``draft_precision``: quantize the
+    draft weights (``"int8"``/``"fp8"``; None = f32)."""
+
+    draft_model: Any
+    k: int = 4
+    draft_precision: Optional[str] = None
+
+
+__all__ = ["SpecConfig", "DraftEngine", "SpecVerifier", "accept_length",
+           "oracle_token", "oracle_tokens"]

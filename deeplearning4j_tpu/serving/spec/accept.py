@@ -1,0 +1,80 @@
+"""Acceptance rules for speculative decoding — and the ONE sampling oracle.
+
+The whole correctness story of the draft/verify subsystem reduces to a
+single function: ``oracle_token`` is the engine's deterministic sampling
+rule — top-k filter on ``log(probs)``, argmax when ``temperature == 0``,
+else ``categorical(fold_in(PRNGKey(seed), position), logits / temp)``.
+It is a pure function of (distribution, request seed, position), never of
+the slot index, co-tenants, or arrival schedule (docs/DECODING.md
+"Determinism rules"). `DecodeEngine._step_impl`, ``generate_naive`` AND
+the speculative verify program all call this one definition, so the
+token the verifier would have emitted at a position is — by construction,
+not by tolerance — the token the non-speculative engine emits there.
+
+Acceptance is *sample matching*: drafted token ``d_j`` is accepted iff it
+equals the oracle token for position j computed from the TARGET model's
+distribution. Accepted prefixes are therefore bitwise-identical to the
+non-speculative trajectory for greedy (exact-match acceptance, the
+Leviathan et al. 2023 greedy special case) and for temperature sampling
+(the seeded sample is the same sample the engine would have drawn — the
+fixed-seed trace form of lossless rejection sampling). The first
+mismatching position emits the oracle token itself (the "bonus" /
+correction token), so every verify call advances each slot by at least
+one token.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def oracle_token(logits, seed, pos, temp, top_k):
+    """The engine sampling rule for ONE distribution row.
+
+    ``logits``: (V,) log-probabilities (any monotone transform of the
+    output softmax); ``seed``/``pos``/``temp``/``top_k``: scalars. Returns
+    the sampled token id (int32). Greedy (``temp == 0``) is the argmax of
+    the top-k-filtered row; sampled is categorical under the per-request
+    key ``fold_in(PRNGKey(seed), pos)``. Op-for-op the historical
+    DecodeEngine/_step_impl and generate_naive rule — both now call this.
+    """
+    V = logits.shape[-1]
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    thr = jnp.sort(logits)[::-1][k - 1]
+    logits = jnp.where(logits >= thr, logits, -jnp.inf)
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+    safe_t = jnp.where(temp > 0, temp, 1.0).astype(logits.dtype)
+    sampled = jax.random.categorical(key, logits / safe_t).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
+
+
+# batched rule: one row per slot — (S, V) logits, (S,) seed/pos/temp/top_k
+oracle_tokens = jax.vmap(oracle_token)
+
+
+def accept_length(oracle, draft, n_in):
+    """Leading-match acceptance over a k-token draft window.
+
+    ``oracle``/``draft``: (..., k) token ids — the target's oracle tokens
+    and the draft's proposals for the same positions. ``n_in``: (...,)
+    number of valid draft positions this call (0 = slot inert). Returns
+    ``(accepted, emitted)``:
+
+    - ``accepted`` = length of the longest prefix where every drafted
+      token equals its oracle token (capped at ``n_in``),
+    - ``emitted`` = ``min(accepted + 1, n_in)`` — the accepted prefix plus
+      the oracle's correction token at the first mismatch (when the whole
+      window matches there is no correction slot left inside the window,
+      so emitted == accepted == n_in).
+
+    Pure jnp, shape-polymorphic: runs inside the verify program on (S, k)
+    arrays and eagerly on numpy rows in tests (the host-side reference).
+    """
+    k = draft.shape[-1]
+    valid = jnp.arange(k) < n_in[..., None]
+    m = ((oracle == draft) & valid).astype(jnp.int32)
+    accepted = jnp.cumprod(m, axis=-1).sum(axis=-1)
+    emitted = jnp.minimum(accepted + 1, n_in)
+    return accepted, emitted

@@ -1,0 +1,181 @@
+"""Draft half of the speculative decoder: a tiny model proposing k
+tokens per scheduler tick through ONE compiled, donated program.
+
+The draft engine is slot-aligned with its owning ``DecodeEngine``: slot i
+of the draft state tree shadows slot i of the target engine, and every
+scheduling decision rides in as (S,)-shaped data (``n_steps`` masks,
+never shapes) so the program compiles exactly once — the same
+trace-count discipline the target step program pins.
+
+One call runs a length-k ``lax.scan`` of the draft model's
+``decode_step``: position t consumes ``given[:, t]`` while t < n_given
+(the correction/prompt tokens the host supplies) and the draft's own
+previous proposal after that, and proposes via the SAME sampling oracle
+as the target (serving/spec/accept.py) — under temperature sampling the
+shared ``fold_in(seed, position)`` key couples the draft's categorical
+draw to the target's (Gumbel-max with shared noise), which is what makes
+a good draft's proposals match the target oracle far more often than an
+independent draw would.
+
+Rewind: recurrent carries are snapshotted after every scan position into
+(S, k, ...) stacks held INSIDE the donated tree; the next call resumes
+from stack index ``sel`` (host-computed: emitted-1 after a verify, m-1
+after prompt catch-up). Positional leaves (attention KV, always dense
+here) stay in place and are overwritten next tick before the causal mask
+can read them (serving/spec/rewind.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.quant import (dequantize_tree, record_weight_bytes,
+                                      resolve_precision, tree_bytes)
+from deeplearning4j_tpu.serving.spec.accept import oracle_tokens
+from deeplearning4j_tpu.serving.spec.rewind import map_state
+
+
+class DraftEngine:
+    """k-token draft proposer for one DecodeEngine (``owner`` = its id).
+
+    ``precision`` quantizes the draft weights through the same policy as
+    serving weights (docs/QUANTIZATION.md): int8/fp8 drafts stream from
+    HBM at quantized width — the draft step is tiny and bandwidth-bound,
+    so this is nearly free acceptance-rate-per-second.
+    """
+
+    def __init__(self, model, owner, slots, max_len, k, vocab,
+                 precision=None):
+        self.model = model
+        self.owner = owner
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.k = int(k)
+        self.vocab = int(vocab)
+        self.programs = 0            # exact XLA trace count (pin: 1)
+        self.precision = (resolve_precision(precision)
+                          if precision is not None else "f32")
+        from deeplearning4j_tpu import exec as ex
+        execu = getattr(model, "_executor", None) or ex.get_executor()
+        self._live = None
+        if self.precision != "f32":
+            qp = execu.prepare_params(model.params, self.precision)
+            st = jax.tree_util.tree_map(jnp.asarray, model.state)
+            self._live = (qp, st)
+            record_weight_bytes(f"{owner}-draft", self.precision,
+                                tree_bytes(qp))
+        self._tree = None
+        self._run = execu.jit(
+            self._impl,
+            in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS) + (ex.BATCH,) * 9,
+            out_specs=(ex.BATCH, ex.SLOTS),
+            donate_argnums=(2,))
+
+    def _weights(self):
+        if self._live is not None:
+            return self._live
+        return self.model.params, self.model.state
+
+    def ensure_state(self):
+        """Donated draft tree: the model's dense decode state with every
+        carry leaf widened to a (S, k, ...) snapshot stack (index = carry
+        after scan position t); positional leaves keep their cache shape."""
+        if self._tree is None:
+            base = self.model.init_decode_state(self.slots, self.max_len)
+            self._tree = map_state(
+                self.model, base,
+                on_carry=lambda a: jnp.zeros(
+                    (a.shape[0], self.k) + a.shape[1:], a.dtype),
+                on_positional=lambda a: a)
+
+    # ------------------------------------------------------------- program
+    def _impl(self, params, state, tree, given, n_given, n_steps, pos0,
+              sel, reset, seeds, temps, topk):
+        """ONE draft tick for all S slots: slot i resumes its carries from
+        snapshot ``sel[i]``, consumes ``given[i, :n_given[i]]`` then its
+        own proposals, runs ``n_steps[i]`` scan positions (0 = inert,
+        state bit-frozen) at positions ``pos0[i] + t``, and returns the
+        (S, k) proposals plus the re-stacked donated tree."""
+        from deeplearning4j_tpu.exec.programs import is_registering
+        if not is_registering():
+            self.programs += 1
+        params = dequantize_tree(params)
+        S, K = self.slots, self.k
+        rows = jnp.arange(S)
+
+        def wipe(a):
+            r = reset.reshape((S,) + (1,) * (a.ndim - 1))
+            return jnp.where(r, jnp.zeros_like(a), a)
+
+        # fresh slots wipe INSIDE the program (same rule as the target
+        # step): stacks and caches go to zero, sel=0 resumes a zero carry
+        tree0 = jax.tree_util.tree_map(wipe, tree)
+        d0 = map_state(self.model, tree0,
+                       on_carry=lambda a: a[rows, sel],
+                       on_positional=lambda a: a)
+
+        def body(carry, t):
+            d, prev = carry
+            tok = jnp.where(t < n_given, given[:, t], prev).astype(jnp.int32)
+            x = jax.nn.one_hot(tok, self.vocab, dtype=jnp.float32)[:, None, :]
+            y, nd = self.model.decode_step(params, state, d, x, pos0 + t)
+            prop = oracle_tokens(jnp.log(y[:, 0, :]), seeds, pos0 + t,
+                                 temps, topk)
+            live = t < n_steps
+
+            def keep(new, old):
+                m = live.reshape((S,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, old)
+
+            nd = jax.tree_util.tree_map(keep, nd, d)
+            prop = jnp.where(live, prop, 0).astype(jnp.int32)
+            # snapshot the carries only; positional caches would stack to
+            # k full copies — a scalar dummy keeps the pytree constant
+            snap = map_state(self.model, nd,
+                             on_carry=lambda a: a,
+                             on_positional=lambda a: jnp.zeros((), a.dtype))
+            return (nd, prop), (prop, snap)
+
+        prev0 = jnp.zeros(S, jnp.int32)
+        (d, _), (props, snaps) = jax.lax.scan(body, (d0, prev0),
+                                              jnp.arange(K))
+        # donated tree out: carries re-stacked from the (K, S, ...) scan
+        # snapshots, positional caches from the final scan state
+        new_tree = map_state(self.model, snaps,
+                             on_carry=lambda s, f: jnp.moveaxis(s, 0, 1),
+                             on_positional=lambda s, f: f,
+                             rest=(d,))
+        live = n_steps > 0
+
+        def freeze(new, old):
+            m = live.reshape((S,) + (1,) * (new.ndim - 1))
+            return jnp.where(m, new, old)
+
+        # inert slots stay bit-identical (their stacks are NOT re-stacked
+        # with repeated carries — frozen against the pre-scan tree)
+        new_tree = jax.tree_util.tree_map(freeze, new_tree, tree0)
+        return jnp.moveaxis(props, 0, 1), new_tree
+
+    # ---------------------------------------------------------------- host
+    def step(self, given, n_given, n_steps, pos0, sel, reset, seeds,
+             temps, topk):
+        """Run one draft tick; returns the (S, k) proposals as numpy."""
+        self.ensure_state()
+        params, state = self._weights()
+        c0, t0 = self.programs, time.perf_counter()
+        props, self._tree = self._run(params, state, self._tree, given,
+                                      n_given, n_steps, pos0, sel, reset,
+                                      seeds, temps, topk)
+        props = np.asarray(props)
+        if self.programs > c0:
+            from deeplearning4j_tpu.exec.programs import get_programs
+            get_programs().record(
+                self.owner, "draft", self._run,
+                (params, state, self._tree, given, n_given, n_steps, pos0,
+                 sel, reset, seeds, temps, topk),
+                compile_seconds=time.perf_counter() - t0)
+        return props

@@ -1,0 +1,85 @@
+"""Carry-vs-positional decode-state surgery for speculative rewind.
+
+A draft/verify tick advances decode state by up to k positions and then
+rolls back to the accepted prefix. The rollback strategy differs by leaf
+class (nn/layers/base.py ``positional_state_keys``):
+
+- POSITIONAL leaves (attention KV caches, dense ``k``/``v`` or paged
+  ``pk``/``pv``): written at explicit position indices and read through a
+  causal ``key_pos <= query_pos`` mask — rejected positions are simply
+  left in place. The next tick re-writes them (scatter-before-gather
+  inside the same device call) before any query's causal horizon reaches
+  them, so a stale row is never read. No rollback state needed.
+- CARRY leaves (recurrent h/c tuples): position-free — the carry after
+  token t depends on every token up to t, so rejecting token t means the
+  carry must be restored to its value after token ``a`` (the last
+  accepted one). These are snapshotted per chunk position
+  (``prefill_chunk(..., carry_stack=True)`` / the draft scan) and the
+  rollback selects snapshot ``e - 1``.
+
+The helpers here walk a model's decode-state container (list for
+MultiLayerNetwork, node-name dict for ComputationGraph) with the OWNING
+layer in hand, so dict keys can be classified against that layer's
+``positional_state_keys``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def layer_entries(model):
+    """``[(key, layer)]`` pairs where ``key`` indexes the model's decode
+    state container: integers for MultiLayerNetwork's per-layer list,
+    layer-node names for ComputationGraph's dict."""
+    if hasattr(model.conf, "network_inputs"):
+        return [(n, model.conf.nodes[n].layer)
+                for n in model.conf.topological_order
+                if model.conf.nodes[n].kind == "layer"]
+    return list(enumerate(model.layers))
+
+
+def _map_sub(sub, pos_keys, on_carry, on_positional, rest):
+    """Map one layer's decode-state sub-tree, dispatching each leaf to
+    ``on_carry`` or ``on_positional``. Only dict entries can be positional
+    (attention caches are dicts); tuples (LSTM (h, c)) and bare leaves are
+    always carries. ``rest``: extra same-structure sub-trees passed as
+    additional leaf arguments."""
+    if sub is None:
+        return None
+    tmap = jax.tree_util.tree_map
+    if isinstance(sub, dict):
+        return {k: tmap(on_positional if k in pos_keys else on_carry,
+                        v, *(r[k] for r in rest))
+                for k, v in sub.items()}
+    return tmap(on_carry, sub, *rest)
+
+
+def map_state(model, dstate, on_carry, on_positional, rest=()):
+    """Rebuild ``dstate`` applying ``on_carry`` to recurrent-carry leaves
+    and ``on_positional`` to position-indexed cache leaves. ``rest`` is a
+    tuple of additional trees with the same container structure whose
+    matching leaves ride along as extra arguments (their leaf SHAPES may
+    differ — e.g. a (K,)-stacked snapshot tree zipped with the flat
+    final state)."""
+    out = dict(dstate) if isinstance(dstate, dict) else list(dstate)
+    for key, layer in layer_entries(model):
+        pos_keys = frozenset(getattr(layer, "positional_state_keys", ()))
+        out[key] = _map_sub(dstate[key], pos_keys, on_carry, on_positional,
+                            [r[key] for r in rest])
+    return out
+
+
+def rewound_state(model, new_d, stacks, idx, rows):
+    """Post-verify state: positional leaves keep the chunk's writes (the
+    causal mask hides rejected positions until they are overwritten);
+    layers that returned a carry snapshot stack are rolled back to
+    snapshot ``idx`` — (K, B, ...) stacks indexed as ``s[idx, rows]`` →
+    the carry after the last emitted token of each slot."""
+    out = dict(new_d) if isinstance(new_d, dict) else list(new_d)
+    for key, _layer in layer_entries(model):
+        st = stacks[key]
+        if st is None:
+            continue
+        out[key] = jax.tree_util.tree_map(lambda s: s[idx, rows], st)
+    return out

@@ -5,9 +5,10 @@ variants (``fast=True``) run the same code path on CPU with tiny sizes
 and keep every COUNT/ACCURACY assertion live — the accuracy-delta bars,
 the int8 ≤ 0.30x weight-bytes ratio, the one-program-per-precision pin,
 and the autotuned-ladder compile/pad-waste claims. Only the wall-clock
-ratio assertions (int8 decode ≥ 1.2x bf16) are full-mode-only: CPU
-timings of a dequant-on-the-fly path prove nothing about the TPU's
-memory-bound decode step.
+ratio assertions (int8 decode ≥ 1.2x bf16, speculative decode ≥ 1.8x
+plain) are full-mode-only: CPU timings of a dequant-on-the-fly path or
+a tiny draft model prove nothing about the TPU's memory-bound decode
+step.
 """
 
 import sys
@@ -73,6 +74,22 @@ def test_kv_prefix_row_fast():
     assert row["prefix_hits"] == 3                  # R-1 with fast R=4
     assert row["prefix_tokens_saved"] >= 3 * 16
     assert row["cow_copies"] == 0                   # boundary divergence
+
+
+def test_spec_decode_row_fast():
+    row = bench.bench_spec_decode(fast=True)
+    # the function itself asserts token-identical speculative outputs at
+    # k=2 and k=4, the one-step/one-verify/one-draft compile pins, and
+    # the distilled-draft acceptance floor; the ≥1.8x tokens/sec bar is
+    # full-mode-only (CPU wall clock of a tiny LSTM proves nothing)
+    assert row["unit"] == "tokens/sec"
+    assert row["outputs_token_identical"] is True
+    assert row["compiled_programs"] == [1, 1, 1]
+    assert set(row["acceptance_rate"]) == {2, 4}
+    assert all(r >= 0.3 for r in row["acceptance_rate"].values())
+    assert row["draft_trace_agreement"] >= 0.9
+    assert all(row["drafted_tokens"][k] >= row["accepted_tokens"][k] > 0
+               for k in (2, 4))
 
 
 def test_ladder_row_fast():

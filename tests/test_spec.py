@@ -1,0 +1,273 @@
+"""Speculative decoding subsystem (serving/spec/).
+
+The load-bearing claims pinned here:
+
+- LOSSLESS: speculative output is token-for-token the non-speculative
+  engine's — greedy AND seeded temperature sampling (the fixed-seed
+  trace form of rejection sampling: draft, verify and the plain step
+  share one oracle) — for the charRNN (recurrent carries → snapshot
+  rewind) and the causal transformer (positional KV → causal-mask
+  rewind), over dense and paged KV;
+- COMPILE PINS: one step, one verify, one draft program per engine
+  regardless of k, arrival schedule, prompt lengths or slot mix;
+- REWIND REGRESSION: a slot whose draft windows are ALL fully rejected
+  emits exactly the oracle's correction tokens and continues bitwise —
+  paged KV, prefix cache on and off (garbage KV written for rejected
+  positions is never read and never published);
+- acceptance rule semantics (leading match + correction token);
+- ``generate_naive`` and the engine share the sampling oracle at
+  temperature > 0, not just under greedy argmax.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.serving import DecodeEngine, generate_naive
+from deeplearning4j_tpu.serving.spec import SpecConfig, accept_length
+from deeplearning4j_tpu.zoo.simple import TinyTransformer
+
+V = 13
+
+
+def _lstm_net(seed=7, width=16):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(LSTM(n_out=width, activation="tanh"))
+            .layer(LSTM(n_out=width, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(V))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _transformer(seed=7):
+    return TinyTransformer(vocab_size=V, n_layers=2, d_model=32, n_heads=4,
+                           max_len=64, seed=seed).init()
+
+
+def _draft_transformer():
+    return TinyTransformer(vocab_size=V, n_layers=1, d_model=16, n_heads=2,
+                           max_len=64, seed=3).init()
+
+
+CASES = [([1, 2, 3], 0.0, 0, 0),        # greedy
+         ([5], 0.0, 0, 0),              # one-token prompt: verify wipes
+         ([0, 4, 2, 9, 7], 0.9, 123, 0),  # seeded sampling
+         ([3, 3], 0.7, 7, 5)]           # sampling + top-k filter
+
+
+def _run_cases(eng, max_new=18):
+    return [eng.generate(p, max_new_tokens=max_new, seed=s, temperature=t,
+                         top_k=k, timeout=120)["tokens"]
+            for p, t, s, k in CASES]
+
+
+def _assert_spec_pins(eng, step_programs=1):
+    st = eng.stats()
+    assert st["compiled_programs"] == step_programs, st
+    assert st["spec"]["verify_programs"] == 1, st
+    assert st["spec"]["draft_programs"] == 1, st
+    assert st["spec"]["drafted_tokens"] > 0
+
+
+# ------------------------------------------------------- acceptance rule
+
+def test_accept_length_leading_match_plus_correction():
+    oracle = jnp.array([[5, 6, 7, 8], [5, 6, 7, 8], [5, 6, 7, 8],
+                        [5, 6, 7, 8]])
+    draft = jnp.array([[5, 6, 9, 8],    # match, match, miss, (match)
+                       [5, 6, 7, 8],    # full match
+                       [9, 6, 7, 8],    # first-token miss
+                       [5, 6, 7, 8]])
+    n_in = jnp.array([4, 4, 4, 2])      # last row: short window
+    a, e = accept_length(oracle, draft, n_in)
+    # a trailing match AFTER a miss must not count (cumprod, not sum)
+    assert a.tolist() == [2, 4, 0, 2]
+    # emitted = accepted + correction token, capped at the window
+    assert e.tolist() == [3, 4, 1, 2]
+    a0, e0 = accept_length(oracle, draft, jnp.array([0, 0, 0, 0]))
+    assert a0.tolist() == [0, 0, 0, 0] and e0.tolist() == [0, 0, 0, 0]
+
+
+# ------------------------------------------------- lossless: charRNN
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_spec_matches_plain_charlstm(k):
+    net = _lstm_net()
+    draft = _lstm_net(seed=11, width=8)
+    base = DecodeEngine(net, slots=4, max_len=48).start()
+    spec = DecodeEngine(net, slots=4, max_len=48,
+                        spec=SpecConfig(draft, k=k)).start()
+    try:
+        assert _run_cases(spec) == _run_cases(base)
+        assert base.stats()["compiled_programs"] == 1
+        _assert_spec_pins(spec)
+    finally:
+        base.stop()
+        spec.stop()
+
+
+# -------------------------------------------- lossless: transformer
+
+@pytest.mark.parametrize("kv_kw", [
+    dict(kv="dense"),
+    dict(kv="paged", kv_block_size=16, prefix_cache=False),
+    dict(kv="paged", kv_block_size=16, prefix_cache=True),
+], ids=["dense", "paged", "paged-prefix"])
+def test_spec_matches_plain_transformer(kv_kw):
+    net = _transformer()
+    draft = _draft_transformer()
+    base = DecodeEngine(net, slots=4, max_len=64, **kv_kw).start()
+    spec = DecodeEngine(net, slots=4, max_len=64,
+                        spec=SpecConfig(draft, k=4), **kv_kw).start()
+    try:
+        assert _run_cases(spec) == _run_cases(base)
+        _assert_spec_pins(spec)
+    finally:
+        base.stop()
+        spec.stop()
+
+
+def test_spec_with_chunked_prefill_matches_plain():
+    """Chunked prefill + speculation compose: the chunk program consumes
+    the prompt, the draft catches up in parallel, verify emits. The plain
+    step program never even runs in this configuration (0 traces)."""
+    net = _transformer()
+    kv_kw = dict(kv="paged", kv_block_size=16, prefix_cache=True,
+                 chunk_tokens=4)
+    base = DecodeEngine(net, slots=4, max_len=64, **kv_kw).start()
+    spec = DecodeEngine(net, slots=4, max_len=64,
+                        spec=SpecConfig(_draft_transformer(), k=4),
+                        **kv_kw).start()
+    try:
+        assert _run_cases(spec) == _run_cases(base)
+        st = spec.stats()
+        assert st["compiled_programs"] <= 1
+        assert st["spec"]["verify_programs"] == 1
+        assert st["spec"]["draft_programs"] == 1
+    finally:
+        base.stop()
+        spec.stop()
+
+
+# ------------------------------------- schedule invariance + compile pins
+
+def test_spec_arrival_schedule_invariance():
+    """The same requests produce the same tokens whether submitted as a
+    burst (slots share draft/verify calls) or strictly one at a time
+    (each runs alone) — and the whole mix still compiles exactly one
+    step, one verify, one draft program."""
+    net = _lstm_net()
+    draft = _lstm_net(seed=11, width=8)
+    eng = DecodeEngine(net, slots=4, max_len=48,
+                       spec=SpecConfig(draft, k=4)).start()
+    try:
+        sequential = _run_cases(eng)
+        futs = [eng.submit(p, max_new_tokens=18, seed=s, temperature=t,
+                           top_k=k) for p, t, s, k in CASES]
+        burst = [f.result(timeout=120)["tokens"] for f in futs]
+        assert burst == sequential
+        _assert_spec_pins(eng)
+    finally:
+        eng.stop()
+
+
+# --------------------------------------------- full-rejection rewind
+
+@pytest.mark.parametrize("prefix_cache", [False, True],
+                         ids=["no-prefix", "prefix"])
+def test_fully_rejected_windows_rewind_bitwise_paged(prefix_cache):
+    """Regression for the paged rewind path: an adversarial draft whose
+    proposals NEVER match forces every window to full rejection (emit =
+    correction token only). The stream must still be bitwise the plain
+    engine's, including a SECOND request that (with the prefix cache on)
+    re-claims blocks published by the garbage-writing first stream —
+    proving rejected-position KV is neither read nor published."""
+    net = _transformer()
+    # block_size 4: the 6-token prompt fills one FULL block, so the first
+    # stream publishes it and the second can take a prefix hit
+    kv_kw = dict(kv="paged", kv_block_size=4, prefix_cache=prefix_cache)
+    prompt = [0, 4, 2, 9, 7, 1]
+    base = DecodeEngine(net, slots=2, max_len=64, **kv_kw).start()
+    try:
+        ref = base.generate(prompt, max_new_tokens=20, timeout=120)
+    finally:
+        base.stop()
+    # a token id the greedy trajectory never emits → never equals the
+    # oracle → every draft window is fully rejected
+    unused = sorted(set(range(V)) - set(ref["tokens"]))
+    assert unused, "need a token id outside the reference trajectory"
+    wrong = unused[0]
+
+    spec = DecodeEngine(net, slots=2, max_len=64,
+                        spec=SpecConfig(_draft_transformer(), k=4),
+                        **kv_kw).start()
+    real_step = spec._draft.step
+
+    def adversarial_step(*args, **kw):
+        props = real_step(*args, **kw)
+        return np.full_like(props, wrong)
+
+    spec._draft.step = adversarial_step
+    try:
+        for _ in range(2):   # second pass exercises prefix-block reuse
+            out = spec.generate(prompt, max_new_tokens=20, timeout=120)
+            assert out["tokens"] == ref["tokens"]
+        st = spec.stats()["spec"]
+        assert st["accepted_tokens"] == 0
+        assert st["drafted_tokens"] > 0
+        assert st["acceptance_rate"] == 0.0
+        if prefix_cache:
+            assert spec.stats()["kv"]["prefix_hits"] >= 1
+    finally:
+        spec.stop()
+
+
+# ------------------------------------------------- one sampling oracle
+
+def test_generate_naive_shares_sampling_oracle():
+    """Satellite of the subsystem: the naive generator and the engine run
+    the SAME oracle, so they agree under temperature sampling and top-k
+    filtering, not just under greedy argmax."""
+    net = _lstm_net()
+    eng = DecodeEngine(net, slots=2, max_len=48).start()
+    try:
+        for temp, seed, tk in [(0.0, 0, 0), (0.8, 42, 0), (0.6, 9, 4)]:
+            naive = generate_naive(net, [1, 2, 3], max_new_tokens=12,
+                                   max_len=48, seed=seed, temperature=temp,
+                                   top_k=tk)
+            served = eng.generate([1, 2, 3], max_new_tokens=12, seed=seed,
+                                  temperature=temp, top_k=tk, timeout=120)
+            assert naive["tokens"] == served["tokens"]
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------- guards
+
+def test_spec_config_validation():
+    net = _lstm_net()
+    with pytest.raises(ValueError, match="spec.k"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(_lstm_net(seed=11, width=8), k=0))
+
+    class _Vocab:
+        size = V + 1
+
+    class _Conf:
+        input_type = _Vocab()
+
+    class _BadDraft:
+        conf = _Conf()
+
+    with pytest.raises(ValueError, match="vocabulary"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(_BadDraft(), k=4))
