@@ -177,6 +177,14 @@ class CheckpointManager:
                 os.unlink(victim.path(self.directory))
             except OSError:
                 pass
+            # the AOT artifact rides its checkpoint: rotate them together
+            try:
+                from deeplearning4j_tpu.exec.aot import companion_path
+                aot = companion_path(victim.path(self.directory))
+                if os.path.exists(aot):
+                    os.unlink(aot)
+            except Exception:   # noqa: BLE001 — rotation must not raise
+                pass
 
     def pin(self, iteration: int) -> Checkpoint:
         """Pin the checkpoint saved at ``iteration`` after the fact so it is
@@ -219,6 +227,17 @@ class CheckpointManager:
             return None
         best = max(self._entries, key=lambda c: (c.iteration, c.epoch))
         return best.path(self.directory)
+
+    def latest_aot(self) -> Optional[str]:
+        """The AOT artifact riding the latest checkpoint
+        (``<checkpoint>.aot.zip``), or None when absent — what an
+        autoscaler hands to ``ReplicaProcess(aot=...)``."""
+        path = self.latest()
+        if path is None:
+            return None
+        from deeplearning4j_tpu.exec.aot import companion_path
+        aot = companion_path(path)
+        return aot if os.path.exists(aot) else None
 
 
 def latest_checkpoint(directory) -> Optional[str]:

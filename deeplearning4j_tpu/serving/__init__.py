@@ -27,10 +27,12 @@ from deeplearning4j_tpu.serving.client import InferenceClient
 from deeplearning4j_tpu.serving.router import RetryBudget, Router
 from deeplearning4j_tpu.serving.replica import (
     InProcessReplica, ReplicaProcess)
+from deeplearning4j_tpu.serving.autoscale import Autoscaler
 
 __all__ = [
     "InferenceEngine", "MicroBatcher", "InferenceServer", "InferenceClient",
     "DecodeEngine", "generate_naive", "bucket_ladder", "bucket_for",
     "BlockPool", "PoolExhaustedError", "PrefixCache",
     "Router", "RetryBudget", "ReplicaProcess", "InProcessReplica",
+    "Autoscaler",
 ]

@@ -623,15 +623,62 @@ class DecodeEngine:
             if not r.future.done():
                 r.future.set_exception(err)
 
-    def warmup(self):
+    def warmup(self, aot: Optional[str] = None):
         """Compile the (single) decode-step program through the persistent
         compile cache before the first request — runs one all-inactive step
-        so a fresh process pays ~0 compile on its first ``generate``."""
+        so a fresh process pays ~0 compile on its first ``generate``.
+
+        ``aot``: path to an AOT artifact (exec/aot.py). Every program found
+        there — the step, the paged prefill/copy-on-write side programs,
+        the spec draft/verify pair — is deserialized in milliseconds
+        instead of retraced; its inert warmup call below doubles as the
+        validation run. ``trace_count`` stays 0 for restored programs
+        (restores count in ``dl4jtpu_aot_restores_total``). Any miss falls
+        back to trace-and-save, merging the fresh executable back into the
+        artifact."""
         from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
         setup_compile_cache()
         self._ensure_dstate()
         if self._thread is not None and self._thread.is_alive():
             return self.warmup_seconds    # loop thread owns the state now
+        bundle = None
+        restored = {}
+        if aot is not None:
+            from deeplearning4j_tpu.exec import aot as aot_mod
+            p0, s0 = self._weights()
+            sig = aot_mod.model_signature(p0, s0)
+            bundle, _reason = aot_mod.open_bundle(aot, sig, self.precision)
+            if bundle is None:
+                bundle = aot_mod.AotBundle(sig, self.precision)
+            originals = {k: p for k, p in self._aot_programs().items()}
+            for kind in originals:
+                prog = bundle.restore(self._aot_key(kind), engine=self.id)
+                if prog is not None:
+                    restored[kind] = prog
+            self._swap_programs(restored)
+        try:
+            self._warmup_run()
+        except Exception:
+            if not restored:
+                raise
+            # a restored executable failed its validation run (drift the
+            # artifact envelope could not catch): drop back to the traced
+            # programs wholesale; the failed call may have consumed the
+            # donated state trees, so rebuild them before retracing
+            from deeplearning4j_tpu.exec.aot import note_miss
+            note_miss("corrupt")
+            self._swap_programs(originals)
+            restored = {}
+            self._dstate = None
+            if self._draft is not None:
+                self._draft._tree = None
+            self._ensure_dstate()
+            self._warmup_run()
+        if bundle is not None and self._aot_export(bundle, restored):
+            bundle.save(aot)
+        return self.warmup_seconds
+
+    def _warmup_run(self):
         S = self.slots
         z = np.zeros(S, np.int32)
         f = np.zeros(S, bool)
@@ -676,6 +723,98 @@ class DecodeEngine:
             self._register_program(params, state, step_args,
                                    self.warmup_seconds)
         return self.warmup_seconds
+
+    # ---------------------------------------------------------------- AOT
+    def _aot_programs(self) -> dict:
+        """The engine's hot programs by artifact kind (the current
+        callables — traced jits before a restore, Compiled after)."""
+        progs = {"step": self._step}
+        if self._prefill is not None:
+            progs["prefill"] = self._prefill
+        if self._cow is not None:
+            progs["cow"] = self._cow
+        if self._draft is not None:
+            progs["draft"] = self._draft._run
+            progs["verify"] = self._verifier._jit
+        return progs
+
+    def _swap_programs(self, progs: dict) -> None:
+        if "step" in progs:
+            self._step = progs["step"]
+        if "prefill" in progs:
+            self._prefill = progs["prefill"]
+        if "cow" in progs:
+            self._cow = progs["cow"]
+        if self._draft is not None:
+            if "draft" in progs:
+                self._draft._run = progs["draft"]
+            if "verify" in progs:
+                self._verifier._jit = progs["verify"]
+
+    def _aot_key(self, kind: str) -> str:
+        """Artifact key of one decode program: every shape-determining
+        knob is in the key, so a config change is a key miss (retrace),
+        never a stale restore."""
+        parts = [f"decode:{kind}", f"S{self.slots}", f"L{self.max_len}",
+                 f"kv={self.kv}"]
+        if self.kv == "paged":
+            parts.append(f"bs{self.kv_block_size}"
+                         f":nb{self._pool.num_blocks}")
+        if kind == "prefill":
+            parts.append(f"c{self.chunk_tokens}")
+        if kind in ("draft", "verify"):
+            parts.append(f"k{self._spec_k}")
+        if kind == "draft":
+            from deeplearning4j_tpu.exec import aot as aot_mod
+            dp, ds = self._draft._weights()
+            parts.append(aot_mod.model_signature(dp, ds)[:12])
+        return ":".join(parts)
+
+    def _aot_export(self, bundle, restored: dict) -> int:
+        """Serialize every program NOT restored into ``bundle`` (the
+        trace-and-save half); returns how many were added."""
+        from deeplearning4j_tpu.exec import aot as aot_mod
+        S = self.slots
+        params, state = self._weights()
+        z = np.zeros(S, np.int32)
+        f = np.zeros(S, bool)
+        u, fl = np.zeros(S, np.uint32), np.zeros(S, np.float32)
+        added = 0
+
+        def put(kind, fn, args):
+            nonlocal added
+            if kind in restored:
+                return                  # already in the artifact
+            bundle.add_compiled(self._aot_key(kind),
+                                aot_mod.export_compiled(fn, args))
+            added += 1
+
+        step_args = (z, z, f, f, u, fl, z)
+        if self.kv == "paged":
+            step_args = (np.zeros((S, self.kv_max_blocks), np.int32),
+                         ) + step_args
+        put("step", self._step, (params, state, self._dstate) + step_args)
+        if self._prefill is not None:
+            put("prefill", self._prefill,
+                (params, state, self._dstate,
+                 np.zeros((S, self.kv_max_blocks), np.int32),
+                 np.zeros((S, self.chunk_tokens), np.int32), z, z, f))
+        if self._cow is not None:
+            put("cow", self._cow,
+                (self._dstate, np.zeros(1, np.int32), np.zeros(1, np.int32)))
+        if self._draft is not None:
+            K = self._spec_k
+            zk = np.zeros((S, K), np.int32)
+            dp, ds = self._draft._weights()
+            put("draft", self._draft._run,
+                (dp, ds, self._draft._tree, zk, z, z, z, z, f, u, fl, z))
+            vargs = (zk, zk, z, z, f, u, fl, z)
+            if self.kv == "paged":
+                vargs = (np.zeros((S, self.kv_max_blocks), np.int32),
+                         ) + vargs
+            put("verify", self._verifier._jit,
+                (params, state, self._dstate) + vargs)
+        return added
 
     def _register_program(self, params, state, step_args, wall):
         """Record the (single) decode-step program's cost/memory analysis

@@ -231,6 +231,10 @@ class InferenceEngine:
         self.min_bucket = int(min_bucket)
         self._traced_keys = set()
         self._fwd = None
+        # AOT-restored executables by (bucket, has_mask) — consulted by
+        # _dispatch before the traced path (exec/aot.py; filled by
+        # ``warmup(aot=...)``). Restores never touch trace_count.
+        self._aot: dict = {}
         self._lock = threading.Lock()
         self._live = None          # (params, state) after the first swap
         self._version = 0
@@ -439,10 +443,22 @@ class InferenceEngine:
             mask_p = None if mask is None else self._pad_rows(mask, b)
         with trace.span("device", bucket=b):
             params, state = self._weights()
-            c0 = self.trace_count
-            t0 = time.perf_counter()
-            outs = self._forward_fn()(params, state, padded, mask_p)
-        if self.trace_count > c0:
+            prog = self._aot.get((b, mask_p is not None))
+            if prog is not None:
+                try:
+                    outs = prog(params, state, padded, mask_p)
+                except Exception:
+                    # the restored executable was serialized under
+                    # different shapes/dtypes than this call (e.g. a mask
+                    # length the artifact never saw): drop the entry and
+                    # retrace — correctness beats the fast path
+                    self._aot.pop((b, mask_p is not None), None)
+                    prog = None
+            if prog is None:
+                c0 = self.trace_count
+                t0 = time.perf_counter()
+                outs = self._forward_fn()(params, state, padded, mask_p)
+        if prog is None and self.trace_count > c0:
             # a fresh program was traced: register its cost/memory analysis
             # (the relower hits the compile cache; guarded, off-hot-path)
             from deeplearning4j_tpu.exec.programs import get_programs
@@ -499,8 +515,18 @@ class InferenceEngine:
             yield read(pending.popleft())
 
     # -------------------------------------------------------------- warmup
+    def _aot_key(self, b: int, shapes, dtype,
+                 mask_len: Optional[int] = None) -> str:
+        """Artifact key of one ladder rung: bucket + per-example shapes +
+        dtype (+ mask length for the mask-carrying variant)."""
+        s = ";".join("x".join(str(d) for d in tuple(shp)) for shp in shapes)
+        kind = "graph" if self._is_graph else "mln"
+        key = f"engine:{kind}:b{b}:{s}:{np.dtype(dtype).name}"
+        return key if mask_len is None else f"{key}:mask{mask_len}"
+
     def warmup(self, example_shape, dtype=np.float32, max_batch=None,
-               with_mask_len: Optional[int] = None):
+               with_mask_len: Optional[int] = None,
+               aot: Optional[str] = None):
         """Pre-compile the bucket ladder through the persistent compilation
         cache so the first real request pays ~0 compile time.
 
@@ -508,6 +534,13 @@ class InferenceEngine:
         list of shapes for multi-input graphs. ``max_batch`` caps the ladder
         (default: the engine's max_batch). ``with_mask_len``: also compile
         the mask-carrying variants for (B, T=with_mask_len) masks.
+
+        ``aot``: path to an AOT artifact (exec/aot.py). Rungs found there
+        are deserialized in milliseconds instead of retraced — trace_count
+        stays 0 for them, restores count in ``dl4jtpu_aot_restores_total``.
+        Any miss (absent file, env/model mismatch, unknown rung) falls back
+        to trace-and-save: the rung compiles as usual and the fresh
+        executable is merged back into the artifact.
 
         Each rung is dispatched twice with the second run timed separately,
         so ``rung_costs[b] = {"compile_s", "run_s"}`` records what the rung
@@ -518,15 +551,30 @@ class InferenceEngine:
         setup_compile_cache()
         shapes = (example_shape if isinstance(example_shape, list)
                   else [example_shape])
+        shapes = [tuple(s) for s in shapes]
         cap = min(max_batch or self.max_batch, self.max_batch)
         ladder = [b for b in (self.ladder
                               or bucket_ladder(cap, self.min_bucket))
                   if b <= cap]
+        bundle = None
+        added = 0
+        if aot is not None:
+            from deeplearning4j_tpu.exec import aot as aot_mod
+            p, s = self._weights()
+            sig = aot_mod.model_signature(p, s)
+            bundle, _reason = aot_mod.open_bundle(aot, sig, self.precision)
+            if bundle is None:
+                bundle = aot_mod.AotBundle(sig, self.precision)
         t0 = time.perf_counter()
         self._in_warmup = True    # warmup traffic must not skew autotune
         try:
             for b in ladder:
-                zeros = [jnp.zeros((b,) + tuple(s), dtype) for s in shapes]
+                zeros = [jnp.zeros((b,) + s, dtype) for s in shapes]
+                key = self._aot_key(b, shapes, dtype)
+                if bundle is not None and (b, False) not in self._aot:
+                    prog = bundle.restore(key, engine=self.id)
+                    if prog is not None:
+                        self._aot[(b, False)] = prog
                 ta = time.perf_counter()
                 jax.block_until_ready(self._dispatch(zeros))
                 tb = time.perf_counter()
@@ -535,12 +583,31 @@ class InferenceEngine:
                 self.rung_costs[b] = {
                     "compile_s": max((tb - ta) - (tc - tb), 0.0),
                     "run_s": tc - tb}
+                if bundle is not None and (b, False) not in self._aot:
+                    from deeplearning4j_tpu.exec import aot as aot_mod
+                    params, state = self._weights()
+                    bundle.add_compiled(key, aot_mod.export_compiled(
+                        self._forward_fn(), (params, state, zeros, None)))
+                    added += 1
                 if with_mask_len is not None and not self._is_graph:
                     m = jnp.ones((b, with_mask_len), dtype)
+                    mkey = self._aot_key(b, shapes, dtype, with_mask_len)
+                    if bundle is not None and (b, True) not in self._aot:
+                        prog = bundle.restore(mkey, engine=self.id)
+                        if prog is not None:
+                            self._aot[(b, True)] = prog
                     jax.block_until_ready(self._dispatch(zeros, m))
+                    if bundle is not None and (b, True) not in self._aot:
+                        from deeplearning4j_tpu.exec import aot as aot_mod
+                        params, state = self._weights()
+                        bundle.add_compiled(mkey, aot_mod.export_compiled(
+                            self._forward_fn(), (params, state, zeros, m)))
+                        added += 1
         finally:
             self._in_warmup = False
         self.warmup_seconds = time.perf_counter() - t0
+        if bundle is not None and added:
+            bundle.save(aot)
         return ladder
 
     def autotune(self, max_rungs: Optional[int] = None, apply: bool = True,

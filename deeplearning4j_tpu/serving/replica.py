@@ -146,6 +146,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="mount POST /chaos (test-only fault injection)")
     parser.add_argument("--warmup", action="store_true",
                         help="pre-compile before accepting traffic")
+    parser.add_argument("--aot", default=None,
+                        help="AOT artifact path (exec/aot.py): restore "
+                             "serialized executables instead of retracing, "
+                             "trace-and-save on any miss (implies warmup)")
     parser.add_argument("--checkpoint", default=None,
                         help="swap in the weights of this checkpoint zip "
                              "before accepting traffic (restart from a "
@@ -205,13 +209,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                        prefix_cache=args.prefix_cache,
                        chunk_tokens=args.chunk_tokens,
                        spec_draft=args.spec_draft, spec_k=args.spec_k)
+    # warmup BEFORE the serve loops start so REPLICA_READY / the port-file
+    # handshake mean genuinely ready-to-serve: with --aot this is a
+    # millisecond restore, without it the full trace-and-save
     if srv.decode_engine is not None:
+        if args.warmup or args.aot:
+            srv.decode_engine.warmup(aot=args.aot)
         srv.decode_engine.start()
-        if args.warmup:
-            srv.decode_engine.warmup()
+    if (args.warmup or args.aot) and args.model == "mlp":
+        srv.engine.warmup((4,), max_batch=64, aot=args.aot)
     srv.start()
-    if args.warmup and args.model == "mlp":
-        srv.engine.warmup((4,), max_batch=64)
     if args.checkpoint:
         # boot-time deploy of a promoted checkpoint: the replica starts from
         # its deterministic seed weights and swaps (zero extra compiles,
@@ -277,7 +284,9 @@ class ReplicaProcess:
                  kv: str = "dense", kv_block_size: int = 16,
                  kv_blocks: Optional[int] = None, prefix_cache: bool = False,
                  chunk_tokens: Optional[int] = None,
-                 spec_draft: Optional[str] = None, spec_k: int = 4):
+                 spec_draft: Optional[str] = None, spec_k: int = 4,
+                 aot: Optional[str] = None,
+                 env: Optional[dict] = None):
         self.workdir = workdir
         self.model = model
         self.slots = slots
@@ -298,6 +307,13 @@ class ReplicaProcess:
         # mutable: rolling restarts set this to the latest promoted
         # checkpoint so a restarted replica boots on current weights
         self.checkpoint = checkpoint
+        # AOT artifact for instant cold-start; extra child env (the bench
+        # isolates compile caches per arm through DL4JTPU_JAX_CACHE)
+        self.aot = aot
+        self.extra_env = env
+        # spawn → port-file → first healthy probe, set by wait_ready()
+        self.ready_seconds: Optional[float] = None
+        self._t_spawn: Optional[float] = None
         self.port: Optional[int] = None
         self.proc: Optional[subprocess.Popen] = None
         self._log = os.path.join(workdir, f"{name}.log")
@@ -337,13 +353,18 @@ class ReplicaProcess:
         if self.spec_draft is not None:
             cmd.extend(["--spec-draft", self.spec_draft,
                         "--spec-k", str(self.spec_k)])
+        if self.aot:
+            cmd.extend(["--aot", os.fspath(self.aot)])
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = (_repo_root() + os.pathsep
                              + env.get("PYTHONPATH", ""))
+        if self.extra_env:
+            env.update(self.extra_env)
         # log to a FILE: a full stdout pipe would deadlock a replica that
         # nobody is reading, and post-mortems want the log anyway
         self._logf = open(self._log, "ab")
+        self._t_spawn = time.monotonic()
         self.proc = subprocess.Popen(cmd, stdout=self._logf,
                                      stderr=subprocess.STDOUT, env=env,
                                      cwd=self.workdir)
@@ -374,6 +395,7 @@ class ReplicaProcess:
             while True:
                 try:
                     if cli.health().get("status") == "ok":
+                        self._note_ready()
                         return self
                 except Exception:   # noqa: BLE001 — still booting
                     pass
@@ -389,6 +411,25 @@ class ReplicaProcess:
                 time.sleep(0.05)
         finally:
             cli.close()
+
+    def _note_ready(self) -> None:
+        """Record spawn → first healthy probe: the per-replica cold-start
+        the autoscaler amortizes (``dl4jtpu_replica_ready_seconds``)."""
+        if self._t_spawn is None:
+            return
+        self.ready_seconds = time.monotonic() - self._t_spawn
+        self._t_spawn = None
+        try:
+            from deeplearning4j_tpu.monitor import get_registry
+            get_registry().histogram(
+                "dl4jtpu_replica_ready_seconds",
+                "Wall seconds from process spawn through the port-file "
+                "handshake to the first healthy /healthz probe — the "
+                "cold-start the AOT artifact shrinks.",
+                ("replica",)).labels(replica=self.name).observe(
+                    self.ready_seconds)
+        except Exception:   # noqa: BLE001 — telemetry must not fail boot
+            pass
 
     def stop(self, timeout: float = 30.0) -> None:
         """SIGTERM → graceful drain → exit 0."""
@@ -456,6 +497,10 @@ class InProcessReplica:
             self.srv.decode_engine.start()
         self.srv.start()
         self.port = self.srv.port
+        return self
+
+    def wait_ready(self, timeout: float = 180.0) -> "InProcessReplica":
+        """No-op for handle parity: start() returns already listening."""
         return self
 
     def stop(self) -> None:

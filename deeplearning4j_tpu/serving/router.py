@@ -277,9 +277,14 @@ class Router:
                  upstream_timeout: float = 30.0,
                  tenant_quota: Optional[int] = None,
                  max_outstanding: Optional[int] = None,
+                 hold_for_capacity_s: float = 0.0,
+                 wake_hook: Optional[Callable[[], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
-        if not upstreams:
+        if not upstreams and hold_for_capacity_s <= 0:
+            # scale-to-zero tiers (hold_for_capacity_s > 0) may boot with
+            # an empty replica set: the autoscaler adds the first replica
+            # when the wake hook fires
             raise ValueError("router needs at least one upstream replica")
         self.id = f"router{next(Router._ids)}"
         self._clock = clock
@@ -299,6 +304,8 @@ class Router:
         self.upstream_timeout = float(upstream_timeout)
         self.tenant_quota = tenant_quota
         self.max_outstanding = max_outstanding
+        self.hold_for_capacity_s = float(hold_for_capacity_s)
+        self.wake_hook = wake_hook
         self._replicas: Dict[str, _Replica] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
@@ -350,6 +357,12 @@ class Router:
             "Requests shed at the router before any upstream attempt. "
             "reason: tenant_quota | priority | no_replicas | deadline.",
             ("router", "reason"))
+        self._m_holds = reg.counter(
+            "dl4jtpu_router_capacity_holds_total",
+            "Requests held at the router because no replica was routable "
+            "(scale-to-zero wake path). outcome: served (capacity arrived "
+            "within hold_for_capacity_s) | timeout (shed after the hold).",
+            ("router", "outcome"))
         self._m_probes = reg.counter(
             "dl4jtpu_router_probes_total",
             "Active /healthz probes. result: ok | degraded | draining | "
@@ -406,6 +419,38 @@ class Router:
     @property
     def replicas(self) -> Dict[str, _Replica]:
         return self._replicas
+
+    def add_upstream(self, url: str) -> None:
+        """Admit a replica into rotation at runtime (the autoscaler's
+        scale-up path — callers gate on the replica being warm/healthy
+        BEFORE adding it; the router starts routing immediately).
+        Re-adding a known URL resets its health state."""
+        with self._lock:
+            self._add_replica(url)
+
+    def remove_upstream(self, url: str, drain_timeout: float = 30.0) -> bool:
+        """Drain + remove a replica from rotation (the autoscaler's
+        scale-down path): ``admin_down`` diverts new traffic, in-flight
+        requests get ``drain_timeout`` to finish, then the record and its
+        clients go away. Returns False for an unknown URL. Stopping the
+        actual process is the caller's job — the router only routes."""
+        url = url.rstrip("/")
+        with self._lock:
+            rep = self._replicas.get(url)
+        if rep is None:
+            return False
+        rep.admin_down = True
+        deadline = time.monotonic() + drain_timeout
+        while rep.outstanding > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with self._lock:
+            self._replicas.pop(url, None)
+        for c in (rep.client, rep.probe_client):
+            try:
+                c.close()
+            except Exception:   # noqa: BLE001 — removal must not raise
+                pass
+        return True
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "Router":
@@ -537,6 +582,29 @@ class Router:
             least = min(r.outstanding for r in pool)
             best = [r for r in pool if r.outstanding == least]
             return best[next(self._rr) % len(best)]   # round-robin the tie
+
+    def _hold_for_capacity(self, tried) -> Optional[_Replica]:
+        """Scale-to-zero path: with no routable replica, poke the wake hook
+        (the autoscaler's kick) and hold the request up to
+        ``hold_for_capacity_s`` for capacity to appear — an AOT-restoring
+        replica arrives in well under a second, so a short hold converts a
+        certain 503 into a served request."""
+        if self.hold_for_capacity_s <= 0:
+            return None
+        if self.wake_hook is not None:
+            try:
+                self.wake_hook()
+            except Exception:   # noqa: BLE001 — a broken hook must not 500
+                pass
+        deadline = time.perf_counter() + self.hold_for_capacity_s
+        while time.perf_counter() < deadline:
+            time.sleep(0.05)
+            rep = self._pick(tried)
+            if rep is not None:
+                self._m_holds.labels(router=self.id, outcome="served").inc()
+                return rep
+        self._m_holds.labels(router=self.id, outcome="timeout").inc()
+        return None
 
     # -------------------------------------------------------------- requests
     def _mint_rid(self, supplied: Optional[str]) -> str:
@@ -698,6 +766,10 @@ class Router:
 
         primary = self._pick(tried)
         if primary is None:
+            # scale-to-zero: hold the request briefly while the autoscaler
+            # wakes a replica (AOT restore makes this a sub-second wait)
+            primary = self._hold_for_capacity(tried)
+        if primary is None:
             outcome("shed")
             self._m_sheds.labels(router=self.id, reason="no_replicas").inc()
             return self._err(503, "no_healthy_replicas",
@@ -849,8 +921,10 @@ class Router:
 
     # ------------------------------------------------------------------ info
     def health_info(self) -> dict:
-        states = {url: r.state for url, r in self._replicas.items()}
-        routable = sum(1 for r in self._replicas.values() if r.routable())
+        with self._lock:
+            snapshot = list(self._replicas.items())
+        states = {url: r.state for url, r in snapshot}
+        routable = sum(1 for _, r in snapshot if r.routable())
         if self._stop.is_set():
             return {"status": "draining"}
         if routable == 0:
@@ -868,7 +942,9 @@ class Router:
 
     def stats(self) -> dict:
         reps = {}
-        for url, r in self._replicas.items():
+        with self._lock:
+            snapshot = list(self._replicas.items())
+        for url, r in snapshot:
             reps[url] = {"state": r.state,
                          "outstanding": r.outstanding,
                          "consecutive_failures": r.consecutive_failures,

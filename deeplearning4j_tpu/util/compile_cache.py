@@ -10,7 +10,13 @@ driver's recorded bench) pay ~0 compile time.
 from __future__ import annotations
 
 import os
+import time
 from pathlib import Path
+
+# per-directory (walk_time, stats) — a warmed cache holds thousands of
+# files, and /metrics scrapes two gauges off it; full rglob per scrape
+# would put a directory walk on the monitoring hot path
+_stats_cache: dict = {}
 
 
 def setup_compile_cache(cache_dir=None) -> str:
@@ -43,17 +49,28 @@ def setup_compile_cache(cache_dir=None) -> str:
     return str(d)
 
 
-def cache_stats(cache_dir=None) -> dict:
+def cache_stats(cache_dir=None, ttl: float = 5.0) -> dict:
     """Entry count + total bytes of the persistent cache directory (the
     serving /stats surface: lets an operator confirm a warmed process will
     really serve its first request compile-free). Safe before setup — an
-    absent directory reports zero entries."""
+    absent directory reports zero entries.
+
+    The walk is memoized for ``ttl`` seconds per directory so back-to-back
+    /metrics scrapes of a large warmed cache don't each pay a full
+    ``rglob``; ``ttl=0`` forces a fresh walk."""
     d = Path(cache_dir or os.environ.get("DL4JTPU_JAX_CACHE")
              or Path(__file__).resolve().parents[2] / ".jax_cache")
+    key = str(d)
+    now = time.monotonic()
+    hit = _stats_cache.get(key)
+    if hit is not None and ttl > 0 and now - hit[0] < ttl:
+        return dict(hit[1])
     entries = bytes_ = 0
     if d.is_dir():
         for p in d.rglob("*"):
             if p.is_file():
                 entries += 1
                 bytes_ += p.stat().st_size
-    return {"dir": str(d), "entries": entries, "bytes": bytes_}
+    stats = {"dir": key, "entries": entries, "bytes": bytes_}
+    _stats_cache[key] = (now, stats)
+    return dict(stats)

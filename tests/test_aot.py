@@ -1,0 +1,224 @@
+"""AOT program artifacts (exec/aot.py): serialize/restore roundtrip,
+fall-back-to-retrace on every artifact-level key mismatch (a stale
+program must NEVER be deserialized), miss accounting, and the artifact
+riding checkpoint rotation."""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.exec import aot
+
+
+def _miss_count(reason):
+    return aot._aot_metrics()["misses"].labels(reason=reason).value
+
+
+def _restore_count(engine):
+    return aot._aot_metrics()["restores"].labels(engine=engine).value
+
+
+# ---------------------------------------------------------------- bundle io
+def test_bundle_roundtrip_bitwise(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda a, b: a @ b + 1.0)
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    y = np.arange(8, dtype=np.float32).reshape(4, 2)
+    want = np.asarray(fn(x, y))
+
+    b = aot.AotBundle("sig-a", "f32")
+    b.add_compiled("matmul", aot.export_compiled(fn, (x, y)))
+    path = str(tmp_path / "art.aot.zip")
+    b.save(path)
+
+    loaded, reason = aot.open_bundle(path, "sig-a", "f32")
+    assert reason is None and "matmul" in loaded
+    r0 = _restore_count("t")
+    prog = loaded.restore("matmul", engine="t")
+    assert prog is not None
+    assert _restore_count("t") == r0 + 1
+    got = np.asarray(prog(jnp.asarray(x), jnp.asarray(y)))
+    assert np.array_equal(got, want)
+
+
+def test_bundle_merge_save_unions_programs(tmp_path):
+    import jax
+
+    path = str(tmp_path / "art.aot.zip")
+    fn1 = jax.jit(lambda a: a + 1)
+    fn2 = jax.jit(lambda a: a * 2)
+    x = np.zeros(3, np.float32)
+
+    b1 = aot.AotBundle("sig", "f32")
+    b1.add_compiled("p1", aot.export_compiled(fn1, (x,)))
+    b1.save(path)
+    b2 = aot.AotBundle("sig", "f32")
+    b2.add_compiled("p2", aot.export_compiled(fn2, (x,)))
+    b2.save(path)
+
+    assert aot.AotBundle.load(path).keys() == {"p1", "p2"}
+
+
+def test_companion_path():
+    assert aot.companion_path("/d/model.zip") == "/d/model.aot.zip"
+    assert aot.companion_path("/d/model") == "/d/model.aot.zip"
+
+
+# ------------------------------------------------- artifact-level mismatches
+@pytest.mark.parametrize("field,value,reason", [
+    ("backend", "tpu-v9", "backend"),
+    ("jaxlib", "0.0.0-stale", "jaxlib"),
+    ("model_sig", "deadbeef" * 4, "model_sig"),
+    ("precision", "int8", "precision"),
+])
+def test_open_bundle_rejects_mismatch(tmp_path, field, value, reason):
+    """Each envelope gate rejects the WHOLE bundle with its own miss
+    reason — the program inside is never offered for deserialization."""
+    import jax
+
+    env = aot._env_fingerprint()
+    sig = "a" * 32
+    kwargs = {"model_sig": sig, "precision": "f32", "env": env}
+    b = aot.AotBundle(**kwargs)
+    b.add_compiled("p", aot.export_compiled(
+        jax.jit(lambda a: a + 1), (np.zeros(2, np.float32),)))
+    # tamper ONE envelope field
+    if field in ("backend", "jaxlib"):
+        b2 = aot.AotBundle(sig, "f32", env=dict(env, **{field: value}))
+    elif field == "model_sig":
+        b2 = aot.AotBundle(value, "f32", env=env)
+    else:
+        b2 = aot.AotBundle(sig, value, env=env)
+    b2._programs = dict(b._programs)
+    path = str(tmp_path / "art.aot.zip")
+    b2.save(path)
+
+    m0 = _miss_count(reason)
+    got, why = aot.open_bundle(path, sig, "f32")
+    assert got is None and why == reason
+    assert _miss_count(reason) == m0 + 1
+
+
+def test_open_bundle_unknown_format_and_corrupt(tmp_path):
+    fmt = str(tmp_path / "fmt.aot.zip")
+    with zipfile.ZipFile(fmt, "w") as z:
+        z.writestr("meta.json", json.dumps({"format": "someone-else/v9"}))
+    m0 = _miss_count("format")
+    got, why = aot.open_bundle(fmt, "s", "f32")
+    assert got is None and why == "format"
+    assert _miss_count("format") == m0 + 1
+
+    bad = str(tmp_path / "bad.aot.zip")
+    with open(bad, "wb") as f:
+        f.write(b"not a zip at all")
+    m0 = _miss_count("corrupt")
+    got, why = aot.open_bundle(bad, "s", "f32")
+    assert got is None and why == "corrupt"
+    assert _miss_count("corrupt") == m0 + 1
+
+    m0 = _miss_count("no_artifact")
+    got, why = aot.open_bundle(str(tmp_path / "absent.zip"), "s", "f32")
+    assert got is None and why == "no_artifact"
+    assert _miss_count("no_artifact") == m0 + 1
+
+
+def test_key_miss_counts_and_returns_none():
+    b = aot.AotBundle("s", "f32")
+    m0 = _miss_count("key")
+    assert b.restore("never-added") is None
+    assert _miss_count("key") == m0 + 1
+
+
+# -------------------------------------------------------- engine-level path
+def test_engine_restore_zero_compiles_bitwise(tmp_path):
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+    from deeplearning4j_tpu.serving.replica import build_model
+
+    art = str(tmp_path / "mlp.aot.zip")
+    x = np.linspace(0.0, 1.0, 8, dtype=np.float32).reshape(2, 4)
+
+    e1 = InferenceEngine(build_model("mlp"))
+    e1.warmup((4,), max_batch=4, aot=art)      # trace-and-save
+    assert e1.trace_count > 0
+    want = np.asarray(e1.predict(x))
+
+    e2 = InferenceEngine(build_model("mlp"))
+    e2.warmup((4,), max_batch=4, aot=art)      # restore
+    assert e2.trace_count == 0
+    got = np.asarray(e2.predict(x))
+    assert e2.trace_count == 0                 # serving didn't trace either
+    assert np.array_equal(got, want)
+
+
+def test_engine_stale_model_sig_falls_back_to_retrace(tmp_path):
+    """An artifact built for a DIFFERENT architecture must be rejected at
+    the envelope (miss{model_sig}) and the engine must retrace — never
+    deserialize a stale program."""
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+    from deeplearning4j_tpu.serving.replica import build_model
+
+    art = str(tmp_path / "other.aot.zip")
+    e1 = InferenceEngine(build_model("charlstm"))
+    e1.warmup((8, 16), max_batch=2, aot=art)   # charlstm-signed artifact
+
+    m0 = _miss_count("model_sig")
+    e2 = InferenceEngine(build_model("mlp"))
+    e2.warmup((4,), max_batch=2, aot=art)
+    assert _miss_count("model_sig") == m0 + 1
+    assert e2.trace_count > 0                  # retraced, fresh programs
+    out = np.asarray(e2.predict(np.zeros((2, 4), np.float32)))
+    assert out.shape == (2, 3)
+
+
+def test_decode_restore_zero_compiles_token_identical(tmp_path):
+    from deeplearning4j_tpu.serving.decode import DecodeEngine
+    from deeplearning4j_tpu.serving.replica import build_model
+
+    art = str(tmp_path / "lstm.aot.zip")
+    net = build_model("charlstm")
+    kw = dict(slots=2, max_len=32)
+
+    d1 = DecodeEngine(net, **kw)
+    d1.warmup(aot=art)
+    assert d1.trace_count == 1
+    d1.start()
+    want = d1.generate([1, 2, 3], max_new_tokens=8, seed=3,
+                       temperature=0.5, top_k=3)["tokens"]
+    d1.stop()
+
+    d2 = DecodeEngine(net, **kw)
+    d2.warmup(aot=art)
+    assert d2.trace_count == 0
+    d2.start()
+    got = d2.generate([1, 2, 3], max_new_tokens=8, seed=3,
+                      temperature=0.5, top_k=3)["tokens"]
+    d2.stop()
+    assert got == want
+
+
+# ------------------------------------------------------- checkpoint rotation
+def test_rotation_unlinks_companion_and_latest_aot(tmp_path):
+    from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.serving.replica import build_model
+
+    net = build_model("mlp")
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    paths = []
+    for i in (1, 2):
+        net.iteration = i
+        paths.append(mgr.save(net))
+    for p in paths:
+        with open(aot.companion_path(p), "wb") as f:
+            f.write(b"artifact-bytes")
+    assert mgr.latest_aot() == aot.companion_path(paths[-1])
+
+    net.iteration = 3
+    mgr.save(net)                              # rotates iteration 1 away
+    assert not os.path.exists(paths[0])
+    assert not os.path.exists(aot.companion_path(paths[0]))
+    assert os.path.exists(aot.companion_path(paths[1]))

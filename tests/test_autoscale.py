@@ -1,0 +1,154 @@
+"""Fleet autoscaling (serving/autoscale.py) and the router's runtime
+replica-set edges (add/remove_upstream, scale-to-zero hold + wake).
+
+The decision logic is driven through ``evaluate_once()`` with a fake
+clock — no sleeping out grace periods; only the scale-to-zero test runs
+the real loop thread, because the held request genuinely waits on it."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (Autoscaler, InferenceClient,
+                                        InProcessReplica, Router)
+
+
+def _mlp():
+    return InProcessReplica(model="mlp", chaos=False)
+
+
+@pytest.fixture
+def tier():
+    """One started mlp replica + router; caller-extended fleet is torn
+    down by each test."""
+    rep = _mlp().start()
+    router = Router([rep.url], port=0, probe_interval=0.2).start()
+    try:
+        yield rep, router
+    finally:
+        router.stop()
+        rep.stop()
+
+
+# ------------------------------------------------------------------- router
+def test_add_remove_upstream(tier):
+    rep, router = tier
+    extra = _mlp().start()
+    try:
+        router.add_upstream(extra.url)
+        assert set(router.replicas) == {rep.url, extra.url}
+        assert extra.url in router.stats()["replicas"]
+        assert router.remove_upstream(extra.url) is True
+        assert set(router.replicas) == {rep.url}
+        assert router.remove_upstream("http://127.0.0.1:9") is False
+    finally:
+        extra.stop()
+
+
+def test_router_requires_upstreams_unless_holding():
+    with pytest.raises(ValueError):
+        Router([])
+    r = Router([], hold_for_capacity_s=1.0)     # scale-to-zero config
+    assert r.replicas == {}
+
+
+# ------------------------------------------------------------ scale up/down
+def test_scale_up_on_outstanding_then_drain_on_idle(tier):
+    rep, router = tier
+    now = [0.0]
+    sc = Autoscaler(router, _mlp, min_replicas=1, max_replicas=3,
+                    scale_up_outstanding=2.0, scale_down_outstanding=0.5,
+                    idle_grace_s=10.0, cooldown_s=5.0,
+                    clock=lambda: now[0])
+    sc.adopt(rep)
+    try:
+        router.replicas[rep.url].outstanding = 6        # fake load
+        assert sc.evaluate_once() == "up"
+        assert sc.replica_count == 2 and len(router.replicas) == 2
+
+        # cooldown gates an immediate second grow
+        router.replicas[rep.url].outstanding = 20
+        assert sc.evaluate_once() is None
+        now[0] += 6.0
+        assert sc.evaluate_once() == "up"
+        assert sc.replica_count == 3
+
+        # load vanishes: idle grace must elapse BEFORE any drain
+        for r in router.replicas.values():
+            r.outstanding = 0
+        now[0] += 6.0
+        assert sc.evaluate_once() is None               # grace starts
+        now[0] += 5.0
+        assert sc.evaluate_once() is None               # grace not over
+        now[0] += 6.0
+        assert sc.evaluate_once() == "down"
+        assert sc.replica_count == 2
+        now[0] += 11.0                                  # grace restarts
+        assert sc.evaluate_once() is None
+        now[0] += 11.0
+        assert sc.evaluate_once() == "down"
+        assert sc.replica_count == 1                    # at min: stays
+        now[0] += 50.0
+        assert sc.evaluate_once() is None
+        assert rep.url in router.replicas               # original survives
+    finally:
+        sc.stop(stop_fleet=True)
+
+
+def test_failed_warmup_probe_blocks_admission(tier):
+    rep, router = tier
+    sc = Autoscaler(router, _mlp, min_replicas=1, max_replicas=3,
+                    scale_up_outstanding=2.0,
+                    warmup_probe=lambda h: False)
+    sc.adopt(rep)
+    try:
+        router.replicas[rep.url].outstanding = 6
+        assert sc.evaluate_once() is None       # probe rejected the replica
+        assert sc.replica_count == 1
+        assert set(router.replicas) == {rep.url}
+    finally:
+        sc.stop(stop_fleet=False)
+
+
+def test_signals_shape(tier):
+    rep, router = tier
+    sc = Autoscaler(router, _mlp)
+    sc.adopt(rep)
+    sig = sc.signals()
+    assert set(sig) >= {"replicas", "routable", "outstanding_total",
+                        "outstanding_per_replica", "fast_burn",
+                        "compile_cost_s"}
+    assert sig["replicas"] == 1 and sig["fast_burn"] is False
+
+
+# ------------------------------------------------------------- scale-to-zero
+def test_scale_to_zero_hold_and_wake():
+    holder = {}
+
+    def wake():
+        holder["sc"].wake()
+
+    router = Router([], port=0, hold_for_capacity_s=20.0, wake_hook=wake,
+                    probe_interval=0.2)
+    sc = Autoscaler(router, _mlp, min_replicas=0, max_replicas=1,
+                    interval_s=0.05, cooldown_s=0.2)
+    holder["sc"] = sc
+    router.start()
+    sc.start()
+    cli = InferenceClient(f"http://127.0.0.1:{router.port}", timeout=60.0)
+    try:
+        out = cli.predict(np.zeros((1, 4), np.float32))
+        assert np.asarray(out).shape[-1] == 3
+        assert sc.replica_count == 1            # woken from zero
+        from deeplearning4j_tpu.monitor import get_registry
+        reg = get_registry()
+        held = reg.counter(
+            "dl4jtpu_router_capacity_holds_total", "", ("router", "outcome")
+        ).labels(router=router.id, outcome="served").value
+        assert held >= 1
+    finally:
+        cli.close()
+        sc.stop(stop_fleet=True)
+        router.stop()

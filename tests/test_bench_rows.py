@@ -92,6 +92,31 @@ def test_spec_decode_row_fast():
                for k in (2, 4))
 
 
+def test_cold_start_row_fast():
+    row = bench.bench_cold_start(fast=True)
+    # the function itself asserts bitwise-equal first-request outputs and
+    # ZERO compiles in the restore arm; the ≥5x ready-to-serve speedup and
+    # sub-second restore walls are full-mode-only (see module docstring)
+    assert row["unit"] == "x"
+    assert row["outputs_bitwise_equal"] is True
+    assert row["compiles_after_restore"] == 0
+    assert row["artifact_programs"] >= 4       # 3 ladder rungs + decode step
+    assert row["wall_restore_s"] < row["wall_retrace_s"]
+
+
+def test_autoscale_row_fast():
+    row = bench.bench_autoscale(fast=True)
+    # the function itself asserts zero failed requests, fleet growth under
+    # the tripled load, and the drain back to one replica; the p99-vs-SLO
+    # bound is full-mode-only (in-process replicas pay their first-request
+    # compile inside the storm)
+    assert row["unit"] == "ms"
+    assert row["failed_requests"] == 0
+    assert row["replicas_peak"] > 1
+    assert row["replicas_final"] == 1
+    assert row["served_requests"] > 0
+
+
 def test_ladder_row_fast():
     row = bench.bench_ladder(fast=True)
     assert row["unit"] == "percent"
