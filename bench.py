@@ -89,6 +89,14 @@ BARS = {
     "kv_prefix": 2.0,         # x, effective prefill throughput of a
                               # shared-prefix storm with the prefix cache
                               # vs without (the row's asserted floor)
+    "kv_affinity": 1.5,       # x, effective prefill throughput of a
+                              # shared-prefix fan-out routed with prefix
+                              # affinity + KV migration vs affinity off
+                              # (the row's asserted floor)
+    "kv_tier": 1.0,           # x, long-tail storm throughput with the
+                              # host-memory KV tier vs without — restoring
+                              # a spilled chain must beat recomputing its
+                              # prefill (the row's asserted floor)
     "cold_start": 5.0,        # x, AOT-restore vs retrace wall to first
                               # served request (the row's asserted floor)
     "autoscale": 1000.0,      # ms, p99 SLO bound the autoscale chaos row
@@ -1259,6 +1267,267 @@ def bench_kv_prefix(fast=False):
          "prefix_hits": kv["prefix_hits"],
          "prefix_tokens_saved": kv["prefix_tokens_saved"],
          "cow_copies": kv["cow_copies"],
+         "outputs_bitwise_equal": True})
+
+
+def _counter_total(name, **labels):
+    """Sum a registry counter family's children matching ``labels``."""
+    from deeplearning4j_tpu.monitor import get_registry
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    idx = [fam.labelnames.index(k) for k in labels]
+    return sum(child.value for key, child in fam.children()
+               if all(key[i] == str(labels[k])
+                      for i, k in zip(idx, labels)))
+
+
+def bench_kv_affinity(fast=False):
+    """Disaggregated-fleet row: shared-prefix fan-out through the router,
+    prefix-affinity + KV migration ON vs OFF (docs/SERVING_TIER.md
+    "Disaggregation"). Three tinyattn replicas (1 prefill-role, 2
+    decode-role): the head request lands on the prefill replica (role
+    preference), its finished chain is migrated to both decode replicas
+    over /kv/export + /kv/import, and the router then steers the fan-out
+    by chain affinity — every storm request claims the shared prefix
+    read-only on arrival instead of recomputing it. The affinity-off arm
+    runs the identical fleet and storm with random (least-outstanding)
+    placement, so each replica pays the shared prefill cold in-storm.
+
+    Asserted: ZERO failed requests, every routed output bitwise-equal to
+    a local standalone engine, decode replicas imported + hit the chain,
+    affinity hits counted at the router; (full mode only) effective
+    prefill throughput — storm prompt tokens per second of storm wall,
+    migration excluded from the timed span — ≥ 1.5x the affinity-off
+    arm."""
+    import threading as _threading
+    from deeplearning4j_tpu.serving import (DecodeEngine, InferenceClient,
+                                            InProcessReplica, Router)
+    from deeplearning4j_tpu.serving.replica import CHAR_VOCAB, build_model
+
+    if fast:
+        max_len, bs, chunk, slots, R = 64, 8, 8, 2, 4
+        shared_len, uniq_len, max_new = 40, 4, 2
+    else:
+        max_len, bs, chunk, slots, R = 128, 16, 16, 4, 12
+        shared_len, uniq_len, max_new = 112, 8, 2
+    rs = np.random.RandomState(31)
+    system = [int(t) for t in rs.randint(0, CHAR_VOCAB, shared_len)]
+    storm_prompts = [system + [int(t)
+                               for t in rs.randint(0, CHAR_VOCAB, uniq_len)]
+                     for _ in range(R)]
+    fleet_kw = dict(chaos=False, kv="paged", kv_block_size=bs,
+                    kv_blocks=64, prefix_cache=True, chunk_tokens=chunk,
+                    max_len=max_len, slots=slots)
+    roles = ("prefill", "decode", "decode")
+
+    # ground truth: a local standalone engine, same weights
+    ref_eng = DecodeEngine(build_model("tinyattn"), slots=2,
+                           max_len=max_len).start()
+    try:
+        ref = {tuple(p): ref_eng.generate(p, max_new_tokens=max_new)
+               ["tokens"] for p in [system] + storm_prompts}
+    finally:
+        ref_eng.stop()
+
+    def arm(affinity):
+        reps = [InProcessReplica(model="tinyattn", role=role,
+                                 **fleet_kw).start() for role in roles]
+        router = Router([r.url for r in reps], port=0, probe_interval=None,
+                        hedge=False, prefix_affinity=affinity).start()
+        base = f"http://127.0.0.1:{router.port}"
+        # steady-state every replica (compiles, conn pools) with a short
+        # neutral prompt — too short to publish any prefix block
+        for r in reps:
+            w = InferenceClient(r.url)
+            w.generate([1, 2, 3], max_new_tokens=1)
+            w.close()
+        # the head request: the shared prefix pays its prefill ONCE
+        head = InferenceClient(base)
+        first = head.generate(system, max_new_tokens=max_new)
+        head.close()
+        if affinity:
+            # disaggregation handoff: hand the finished chain to both
+            # decode replicas, then let the router learn who holds what
+            pre = next(r for r in reps if r.srv.role == "prefill")
+            c = InferenceClient(pre.url)
+            payload = c.kv_export(system)
+            c.close()
+            for r in reps:
+                if r.srv.role == "decode":
+                    c = InferenceClient(r.url)
+                    c.kv_import(payload)
+                    c.close()
+            router.refresh_affinity()
+        outs = [None] * R
+        fails = []
+
+        def worker(i):
+            c = InferenceClient(base, timeout=600.0, retries=1)
+            try:
+                outs[i] = c.generate(storm_prompts[i],
+                                     max_new_tokens=max_new)["tokens"]
+            except Exception as e:   # noqa: BLE001 — counted, fatal
+                fails.append(repr(e))
+            finally:
+                c.close()
+
+        ts = [_threading.Thread(target=worker, args=(i,))
+              for i in range(R)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        rep_stats = [(r.srv.role, r.srv.decode_engine.stats())
+                     for r in reps]
+        rid = router.id
+        router.stop()
+        for r in reps:
+            r.stop()
+        assert not fails, fails[:3]
+        eff = sum(len(p) for p in storm_prompts) / wall
+        return first["tokens"], outs, eff, rep_stats, rid
+
+    a_first, a_out, a_eff, a_stats, a_rid = arm(True)
+    r_first, r_out, r_eff, r_stats, _ = arm(False)
+    want = [ref[tuple(p)] for p in storm_prompts]
+    assert a_first == ref[tuple(system)] and r_first == ref[tuple(system)]
+    assert a_out == want, "affinity-routed storm output diverged"
+    assert r_out == want, "random-routed storm output diverged"
+    imports = sum(st["kv"]["migrate_imports"] for role, st in a_stats
+                  if role == "decode")
+    dec_hits = sum(st["kv"]["prefix_hits"] for role, st in a_stats
+                   if role == "decode")
+    assert imports == 2, imports              # both decode replicas loaded
+    assert dec_hits >= 1                      # ...and actually served hits
+    aff_hits = _counter_total("dl4jtpu_router_affinity_requests_total",
+                              router=a_rid, outcome="hit")
+    assert aff_hits >= 1, "no affinity hit counted at the router"
+    for role, st in a_stats:
+        assert st["kv"]["blocks_in_use"] == 0
+    speedup = a_eff / r_eff
+    if not fast:
+        assert speedup >= 1.5, (
+            f"affinity fan-out {a_eff:.0f} tok/s is only {speedup:.2f}x "
+            f"the random-placement tier {r_eff:.0f} tok/s")
+    return _emit(
+        f"KV affinity fan-out (3 replicas 1P+2D, {R} reqs x "
+        f"{shared_len}-tok shared prefix, migrated chain)", speedup, "x",
+        BARS["kv_affinity"],
+        {"effective_prefill_tokens_per_sec": round(a_eff, 1),
+         "random_routing_tokens_per_sec": round(r_eff, 1),
+         "affinity_hits": int(aff_hits),
+         "migrate_imports": imports,
+         "decode_replica_prefix_hits": dec_hits,
+         "failed_requests": 0,
+         "outputs_bitwise_equal": True})
+
+
+def bench_kv_tier(fast=False):
+    """Host-memory KV tier row: a long-tail storm whose working set
+    exceeds the device pool, host tier ON vs OFF (docs/DECODING.md
+    "Host-memory KV tier"). P distinct long prompts cycle for several
+    rounds with short decodes interleaved; the pool can hold barely one
+    long chain, so every round evicts the previous prompts' prefix
+    blocks. With the tier they spill to host RAM and RESTORE on the next
+    round's chain hit; without it each round recomputes the prefill.
+
+    Asserted: outputs bitwise-equal across the arms, spills + restores
+    observed, ONE step program + ≤2 kv side programs (restores are pure
+    host-side block movement — ZERO new XLA programs), pool drained;
+    (full mode only) tier throughput ≥ the no-tier arm AND interleaved
+    short-decode p99 no worse."""
+    from deeplearning4j_tpu.serving import DecodeEngine
+    from deeplearning4j_tpu.zoo.simple import TinyTransformer
+
+    vocab = 29
+    if fast:
+        max_len, bs, chunk, slots, blocks = 64, 8, 8, 2, 9
+        P, rounds, long_len, long_new = 4, 2, 40, 4
+        n_short, short_new = 2, 4
+    else:
+        max_len, bs, chunk, slots, blocks = 128, 8, 16, 2, 17
+        P, rounds, long_len, long_new = 6, 3, 96, 4
+        n_short, short_new = 4, 8
+    net = TinyTransformer(vocab_size=vocab, n_layers=2, d_model=32,
+                          n_heads=4, max_len=max_len).init()
+    rs = np.random.RandomState(23)
+    longs = [[int(t) for t in rs.randint(0, vocab, long_len)]
+             for _ in range(P)]
+    shorts = [[int(t) for t in rs.randint(0, vocab, 3)]
+              for _ in range(rounds * n_short)]
+
+    def storm(host_kv_bytes):
+        eng = DecodeEngine(net, slots=slots, max_len=max_len, kv="paged",
+                           kv_block_size=bs, kv_blocks=blocks,
+                           prefix_cache=True, chunk_tokens=chunk,
+                           host_kv_bytes=host_kv_bytes)
+        eng.warmup()
+        eng.start()
+        outs, short_lat = [], []
+        si = 0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            futs = [(False, time.perf_counter(),
+                     eng.submit(p, max_new_tokens=long_new))
+                    for p in longs]
+            for _ in range(n_short):
+                futs.append((True, time.perf_counter(),
+                             eng.submit(shorts[si],
+                                        max_new_tokens=short_new)))
+                si += 1
+            pending = set(range(len(futs)))
+            while pending:               # completion-time polling: the
+                for i in list(pending):  # short p99 needs real latencies
+                    if futs[i][2].done():
+                        if futs[i][0]:
+                            short_lat.append(
+                                (time.perf_counter() - futs[i][1])
+                                / short_new)
+                        pending.remove(i)
+                time.sleep(0.001)
+            outs.extend(f.result()["tokens"] for _, _, f in futs)
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        info = eng.kv_pool_info()
+        eng.stop()
+        toks = (rounds * sum(len(p) for p in longs)
+                + sum(len(t) for t in outs))
+        return (outs, toks / wall,
+                float(np.percentile(short_lat, 99)), st, info)
+
+    b_out, b_tps, b_p99, b_st, _ = storm(None)
+    t_out, t_tps, t_p99, t_st, t_info = storm(32 << 20)
+    assert t_out == b_out, "host-tier restore changed decode output"
+    tier = t_info["host_tier"]
+    assert tier["spills"] > 0, "storm never exceeded the device pool"
+    assert t_st["kv"]["host_restores"] > 0
+    assert t_st["kv"]["prefix_hits"] > 0
+    assert b_st["compiled_programs"] == 1
+    assert t_st["compiled_programs"] == 1     # restores compile NOTHING
+    assert t_st["kv"]["kv_programs"] <= 2
+    assert t_info["blocks_in_use"] == 0
+    assert t_info["high_water"] > 0
+    speedup = t_tps / b_tps
+    if not fast:
+        assert speedup >= 1.0, (
+            f"host-tier storm {t_tps:.1f} tok/s slower than recompute "
+            f"{b_tps:.1f} tok/s")
+        assert t_p99 <= b_p99, (
+            f"short-decode p99 {t_p99 * 1e3:.1f}ms worse with the tier "
+            f"than {b_p99 * 1e3:.1f}ms without")
+    return _emit(
+        f"KV host tier ({P}x{long_len}-tok long tail x {rounds} rounds, "
+        f"pool {blocks} blocks)", speedup, "x", BARS["kv_tier"],
+        {"tier_tokens_per_sec": round(t_tps, 1),
+         "no_tier_tokens_per_sec": round(b_tps, 1),
+         "host_spills": tier["spills"],
+         "host_restores": t_st["kv"]["host_restores"],
+         "short_decode_p99_ms_tier": round(t_p99 * 1e3, 2),
+         "short_decode_p99_ms_no_tier": round(b_p99 * 1e3, 2),
+         "pool_high_water": t_info["high_water"],
          "outputs_bitwise_equal": True})
 
 
@@ -2631,6 +2900,8 @@ BENCHES = {
     "decode": bench_decode,
     "kv_storm": bench_kv_storm,
     "kv_prefix": bench_kv_prefix,
+    "kv_affinity": bench_kv_affinity,
+    "kv_tier": bench_kv_tier,
     "quantized": bench_quantized,
     "spec_decode": bench_spec_decode,
     "router": bench_router,
@@ -2659,6 +2930,7 @@ _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "parallelwrapper": 150, "sharded": 150, "word2vec": 120,
         "serving": 120, "ladder": 90, "quantized": 150,
         "decode": 150, "kv_storm": 120, "kv_prefix": 120,
+        "kv_affinity": 150, "kv_tier": 120,
         "spec_decode": 180,
         "observability": 160, "robustness": 100,
         "router": 150, "online": 120, "train_perf": 150,

@@ -119,13 +119,44 @@ def export_compiled(jitted, args):
     wrapper) at the shapes of ``args`` for serialization. Runs under the
     registration guard so the relowered python body does not double-count
     the caller's compile accounting; the persistent compile cache makes
-    the XLA half of this relower cheap."""
+    the XLA half of this relower cheap.
+
+    On the CPU backend the relower runs with the persistent cache
+    DISABLED: a CPU executable loaded from the compilation cache cannot
+    be re-serialized (``deserialize_and_load`` of such a payload fails
+    with ``Symbols not found``), so a cache HIT here would poison the
+    artifact. The cache object is a process singleton that ignores
+    config changes after first use, so the dir change alone is not
+    enough — the singleton is reset around the compile (and re-armed
+    after, so the ambient cache keeps working for everything else)."""
+    import jax
+
     from deeplearning4j_tpu.exec.programs import _Registering, _lowerable
     low = _lowerable(jitted)
     if low is None:
         raise TypeError(f"object has no lowerable jit entry: {jitted!r}")
     with _Registering():
-        return low.lower(*args).compile()
+        if jax.default_backend() != "cpu":
+            return low.lower(*args).compile()
+        try:
+            from jax._src import compilation_cache as _cc
+        except Exception:
+            _cc = None
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            if _cc is not None:
+                _cc.reset_cache()
+            # a non-empty compiler_options dict (the value IS the
+            # default, so the program is unchanged) bypasses the
+            # memoized executable of an earlier call at these shapes —
+            # that executable may itself have been loaded from the cache
+            return low.lower(*args).compile(
+                compiler_options={"xla_cpu_enable_fast_math": False})
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            if _cc is not None:
+                _cc.reset_cache()
 
 
 class AotBundle:

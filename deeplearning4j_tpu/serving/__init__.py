@@ -20,8 +20,9 @@ from deeplearning4j_tpu.serving.engine import (
     InferenceEngine, bucket_ladder, bucket_for)
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.decode import DecodeEngine, generate_naive
-from deeplearning4j_tpu.serving.kv import (BlockPool, PoolExhaustedError,
-                                           PrefixCache)
+from deeplearning4j_tpu.serving.kv import (BlockPool, HostKVTier,
+                                           KVMigrateError,
+                                           PoolExhaustedError, PrefixCache)
 from deeplearning4j_tpu.serving.server import InferenceServer
 from deeplearning4j_tpu.serving.client import InferenceClient
 from deeplearning4j_tpu.serving.router import RetryBudget, Router
@@ -32,7 +33,8 @@ from deeplearning4j_tpu.serving.autoscale import Autoscaler
 __all__ = [
     "InferenceEngine", "MicroBatcher", "InferenceServer", "InferenceClient",
     "DecodeEngine", "generate_naive", "bucket_ladder", "bucket_for",
-    "BlockPool", "PoolExhaustedError", "PrefixCache",
+    "BlockPool", "PoolExhaustedError", "PrefixCache", "HostKVTier",
+    "KVMigrateError",
     "Router", "RetryBudget", "ReplicaProcess", "InProcessReplica",
     "Autoscaler",
 ]

@@ -205,6 +205,20 @@ class InferenceClient:
             "temperature": float(temperature),
             "top_k": int(top_k)})
 
+    def kv_export(self, tokens) -> dict:
+        """POST /kv/export — serialize the replica's cached KV block
+        chain for this prompt into a migration payload (see
+        serving/kv/migrate.py). Feed the result to another replica's
+        ``kv_import`` to hand a finished prefill across the fleet."""
+        return self._request("/kv/export",
+                             {"tokens": [int(t) for t in tokens]})
+
+    def kv_import(self, payload: dict) -> dict:
+        """POST /kv/import — restore a ``kv_export`` payload into this
+        replica's pool. An envelope/integrity mismatch raises (HTTP 409)
+        with the destination pool untouched."""
+        return self._request("/kv/import", dict(payload))
+
     def warmup(self, input_shape, max_batch=None) -> dict:
         """Pre-compile the server's bucket ladder for ``input_shape`` (a
         per-example feature shape, or list of shapes for graphs)."""

@@ -128,6 +128,7 @@ class DecodeEngine:
                  kv_block_size: int = 16, kv_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
                  chunk_tokens: Optional[int] = None,
+                 host_kv_bytes: Optional[int] = None,
                  spec=None):
         self.model = model
         self.slots = int(slots)
@@ -146,6 +147,11 @@ class DecodeEngine:
                 f"({kv_block_size})")
         if chunk_tokens is not None and int(chunk_tokens) < 1:
             raise ValueError("chunk_tokens must be >= 1")
+        if host_kv_bytes is not None and (
+                kv != "paged" or not prefix_cache):
+            raise ValueError(
+                "host_kv_bytes requires kv='paged' with prefix_cache=True "
+                "(the tier holds evicted prefix-cache blocks)")
         self.kv = kv
         self.kv_block_size = int(kv_block_size)
         self.chunk_tokens = (int(chunk_tokens) if chunk_tokens is not None
@@ -156,6 +162,14 @@ class DecodeEngine:
         self._prefix: Optional[PrefixCache] = None
         self._tables: Optional[np.ndarray] = None
         self._pending_cows: List[tuple] = []
+        self._host_tier = None
+        # bid -> per-leaf host rows: tier restores claimed during match
+        # whose host→device scatter is still pending (applied in one
+        # batch before the next device call, like _pending_cows)
+        self._pending_restores: dict = {}
+        # export/import closures marshalled onto the loop thread — the
+        # only thread allowed to touch the donated decode state
+        self._kv_ops: deque = deque()
         self._kv_blocked = False
         self._is_graph = hasattr(model.conf, "network_inputs")
         itype = (model.conf.input_types[0] if self._is_graph
@@ -304,6 +318,13 @@ class DecodeEngine:
                         f"({len(carries)} non-pool leaves). Pass "
                         "prefix_cache=False.")
                 self._prefix = PrefixCache(self._pool)
+                if host_kv_bytes is not None:
+                    from deeplearning4j_tpu.serving.kv import HostKVTier
+                    self._host_tier = HostKVTier(int(host_kv_bytes),
+                                                 engine=self.id)
+                    self._prefix.tier = self._host_tier
+                    self._prefix.spill_fn = self._spill_block
+                    self._prefix.restore_fn = self._restore_block
             self._m_kv_programs = reg.counter(
                 "dl4jtpu_kv_compiled_programs_total",
                 "XLA programs traced for the paged-KV side programs "
@@ -335,6 +356,23 @@ class DecodeEngine:
                 "dl4jtpu_kv_prefill_tokens_total",
                 "Prompt tokens prefilled through the chunked-prefill "
                 "program.", ("engine",)).labels(**lab)
+            self._m_host_restores = reg.counter(
+                "dl4jtpu_kv_host_restores_total",
+                "Spilled prefix blocks promoted back from the host tier "
+                "on a second-chance match hit.", ("engine",)).labels(**lab)
+            self._m_migrate_exports = reg.counter(
+                "dl4jtpu_kv_migrate_exports_total",
+                "Block chains serialized for replica-to-replica KV "
+                "migration (/kv/export).", ("engine",)).labels(**lab)
+            self._m_migrate_imports = reg.counter(
+                "dl4jtpu_kv_migrate_imports_total",
+                "Block chains restored from a migration payload "
+                "(/kv/import).", ("engine",)).labels(**lab)
+            self._m_migrate_rejects = reg.counter(
+                "dl4jtpu_kv_migrate_rejects_total",
+                "Migration payloads rejected before touching the pool "
+                "(envelope mismatch, torn bytes, exhausted destination).",
+                ("engine", "reason"))
 
         self._verifier = None
         self._draft = None
@@ -466,11 +504,15 @@ class DecodeEngine:
         """Pool occupancy snapshot for /healthz and stats (None = dense)."""
         if self._pool is None:
             return None
-        return {"blocks": self._pool.usable,
+        info = {"blocks": self._pool.usable,
                 "blocks_free": self._pool.free_count,
                 "blocks_in_use": self._pool.in_use,
                 "blocks_cached": self._pool.cached_count,
-                "block_size": self.kv_block_size}
+                "block_size": self.kv_block_size,
+                "high_water": self._pool.high_water}
+        if self._host_tier is not None:
+            info["host_tier"] = self._host_tier.stats()
+        return info
 
     # ------------------------------------------------------------- the step
     def _step_impl(self, params, state, dstate, tokens, pos, reset, active,
@@ -599,6 +641,15 @@ class DecodeEngine:
             self._thread.join(timeout=10.0)
         err = BatcherStoppedError("decode engine stopped")
         with self._cv:
+            while self._kv_ops:
+                _fn, fut = self._kv_ops.popleft()
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(err)
+            if self._pending_restores:
+                # land claimed-but-pending tier promotions so evictable
+                # restored blocks hold real content across a restart
+                pend, self._pending_restores = self._pending_restores, {}
+                self._apply_host_rows(list(pend.items()))
             if self._pending_swap is not None:
                 # a swap staged against a stopping engine still applies (and
                 # unblocks its waiter) — a restart serves the new weights
@@ -946,10 +997,212 @@ class DecodeEngine:
         r.kv_blocks = []
         self._tables[slot][:] = 0
 
+    # ----------------------------------------- host-side block movement
+    # Migration, spill, and restore move KV as HOST bytes: one numpy
+    # gather/scatter per pool leaf with a jnp.asarray round-trip back into
+    # the (re-donated) decode-state tree. No jitted gather/scatter program
+    # exists for any of it — the compile-count pins (one step program, ≤2
+    # kv side programs) are untouched by design.
+
+    def _pool_leaf_items(self):
+        """``[(key, leaf)]`` for the pool leaves of the decode state,
+        with tree-path keys stable across engines of the same model (the
+        migration wire format's leaf identity)."""
+        from deeplearning4j_tpu.serving.kv import is_pool_path
+        flat, _ = jax.tree_util.tree_flatten_with_path(self._dstate)
+        return [(jax.tree_util.keystr(path), leaf)
+                for path, leaf in flat if is_pool_path(path)]
+
+    def _gather_rows(self, bids):
+        """Per-leaf host gather of the given blocks: key -> ``(n, bs, H,
+        Dh)`` numpy array."""
+        idx = np.asarray(bids, np.int64)
+        return {k: np.asarray(leaf)[idx]
+                for k, leaf in self._pool_leaf_items()}
+
+    def _apply_host_rows(self, writes):
+        """Scatter ``[(bid, {leaf key: (bs, H, Dh) row})]`` into the pool
+        leaves through one host round-trip per touched leaf."""
+        if not writes:
+            return
+        from deeplearning4j_tpu.serving.kv import is_pool_path
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self._dstate)
+        leaves = [leaf for _, leaf in flat]
+        keymap = {jax.tree_util.keystr(path): i
+                  for i, (path, _) in enumerate(flat)
+                  if is_pool_path(path)}
+        arrs = {}
+        for bid, rows in writes:
+            for key, row in rows.items():
+                i = keymap[key]
+                if i not in arrs:
+                    arrs[i] = np.array(leaves[i])
+                arrs[i][bid] = row
+        for i, a in arrs.items():
+            leaves[i] = jnp.asarray(a)
+        self._dstate = jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # ------------------------------------------------- host-tier spill/restore
+    def _spill_block(self, chain_hash, parent, tokens, bid):
+        """Pool-eviction hook (loop thread, via PrefixCache._drop):
+        demote the evicted block's device rows to the host tier. Must
+        never raise — an exception here would leak the block mid-alloc —
+        so any failure degrades to a plain drop."""
+        try:
+            if self._pending_restores.pop(bid, None) is not None:
+                # the block was claimed from the tier but its data never
+                # landed on device; the tier still holds the content
+                return
+            rows = self._gather_rows([bid])
+            self._host_tier.put(chain_hash, parent, tokens,
+                                {k: v[0] for k, v in rows.items()})
+        except Exception:
+            pass
+
+    def _restore_block(self, chain_hash, tokens):
+        """Second-chance hook (loop thread, from PrefixCache.match):
+        claim a fresh pool block for a tier hit and queue its host→device
+        scatter on the pre-step batch. Returns the bid (refcount 1 — the
+        claim belongs to the matching request) or None under pool
+        pressure, which the cache treats as a plain miss."""
+        entry = self._host_tier.get(chain_hash)
+        if entry is None:
+            return None
+        try:
+            bid = self._pool.alloc(1)[0]
+        except PoolExhaustedError:
+            return None
+        self._pending_restores[bid] = entry.rows
+        self._m_host_restores.inc()
+        return bid
+
+    # ------------------------------------------------------------ migration
+    def _drain_kv_ops_locked(self):
+        """Run queued export/import closures (caller holds ``self._cv``,
+        loop thread, step boundary — the only point where the donated
+        decode state may be read or rebuilt)."""
+        while self._kv_ops:
+            fn, fut = self._kv_ops.popleft()
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_result(fn())
+                except BaseException as e:
+                    fut.set_exception(e)
+
+    def _run_kv_op(self, fn):
+        """Marshal ``fn`` onto the loop thread (or run it inline at a
+        safe point when the loop isn't running) and return its result."""
+        fut = Future()
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                self._kv_ops.append((fn, fut))
+                self._cv.notify_all()
+            else:
+                self._ensure_dstate()
+                if fut.set_running_or_notify_cancel():
+                    try:
+                        fut.set_result(fn())
+                    except BaseException as e:
+                        fut.set_exception(e)
+        return fut.result(timeout=60.0)
+
+    def _migrate_envelope(self):
+        """The validity envelope a payload must match to land here: the
+        AOT-bundle discipline (exec/aot.py) applied to KV — same
+        architecture (shape/dtype signature of the SERVING weights), same
+        serving precision, same block geometry, same vocabulary."""
+        from deeplearning4j_tpu.exec import aot as aot_mod
+        p, s = self._weights()
+        return {"model_sig": aot_mod.model_signature(p, s),
+                "precision": self.precision,
+                "block_size": self.kv_block_size,
+                "vocab": int(self.vocab)}
+
+    def kv_export(self, prompt: Sequence[int]) -> dict:
+        """Serialize the cached block chain covering ``prompt``'s full
+        blocks into a migration payload (kv/migrate.py) — the
+        prefill-replica half of disaggregated serving. The chain must
+        already be published (the prefill ran to completion here);
+        otherwise ``KVMigrateError(reason='no_chain')``."""
+        from deeplearning4j_tpu.serving.kv import KVMigrateError, pack_chain
+        from deeplearning4j_tpu.serving.kv.prefix import _ROOT, _chain_hash
+        if self._prefix is None:
+            raise ValueError(
+                "kv_export requires kv='paged' with prefix_cache=True")
+        toks = [int(t) for t in prompt]
+
+        def op():
+            bs = self.kv_block_size
+            bids, chain = [], []
+            h = _ROOT
+            for j in range(len(toks) // bs):
+                blk = toks[j * bs:(j + 1) * bs]
+                h = _chain_hash(h, blk)
+                bid = self._prefix._by_hash.get(h)
+                if bid is None:
+                    break
+                bids.append(bid)
+                chain.extend(blk)
+            if not bids:
+                raise KVMigrateError(
+                    "no cached chain covers this prompt's first block — "
+                    "run the prefill to completion here before exporting",
+                    reason="no_chain")
+            payload = pack_chain(self._gather_rows(bids), chain,
+                                 self._migrate_envelope())
+            self._m_migrate_exports.inc()
+            return payload
+
+        return self._run_kv_op(op)
+
+    def kv_import(self, payload: dict) -> dict:
+        """Restore a migrated chain into this engine's pool: validate the
+        whole payload against the local envelope (no side effects on any
+        mismatch), allocate fresh blocks, scatter the rows host-side, and
+        rebind the page-table identity by re-indexing the same token
+        chain in the prefix cache — continued decode is then an ordinary
+        (bitwise-exact) prefix hit. The decode-replica half."""
+        from deeplearning4j_tpu.serving.kv import (KVMigrateError,
+                                                   unpack_chain)
+        if self._prefix is None:
+            raise ValueError(
+                "kv_import requires kv='paged' with prefix_cache=True")
+
+        def op():
+            leaves = dict(self._pool_leaf_items())
+            tokens, rows = unpack_chain(payload, self._migrate_envelope(),
+                                        leaves)
+            n = len(tokens) // self.kv_block_size
+            try:
+                bids = self._pool.alloc(n)
+            except PoolExhaustedError as e:
+                raise KVMigrateError(
+                    f"destination pool cannot hold the chain: {e}",
+                    reason="exhausted")
+            self._apply_host_rows(
+                [(bid, {k: rows[k][j] for k in rows})
+                 for j, bid in enumerate(bids)])
+            added = self._prefix.insert(tokens, bids)
+            for b in bids:
+                # indexed blocks park in the evictable LRU (cache
+                # entries); blocks the chain already had free right back
+                self._pool.decref(b)
+            self._m_migrate_imports.inc()
+            return {"imported_blocks": added,
+                    "duplicate_blocks": n - added, "tokens": len(tokens)}
+
+        try:
+            return self._run_kv_op(op)
+        except KVMigrateError as e:
+            self._m_migrate_rejects.labels(
+                engine=self.id, reason=e.reason).inc()
+            raise
+
     def _loop(self):
         S = self.slots
         while not self._stop.is_set():
             with self._cv:
+                self._drain_kv_ops_locked()
                 if (self._pending_swap is not None
                         and all(r is None for r in self._slot_reqs)):
                     # step boundary with no live slots: every in-flight
@@ -962,6 +1215,13 @@ class DecodeEngine:
                     self._cv.wait(timeout=0.05)
                     continue
             params, state = self._weights()
+            if self._pending_restores:
+                # host-tier promotions land BEFORE anything can read the
+                # claimed blocks — including the CoW program below, whose
+                # source may itself be a just-restored block
+                with self._cv:
+                    pend, self._pending_restores = self._pending_restores, {}
+                self._apply_host_rows(list(pend.items()))
             if self._pending_cows:
                 # copy-on-write claims run BEFORE the claimer's first
                 # prefill/step can read (or overwrite) the cloned block
@@ -1273,7 +1533,15 @@ class DecodeEngine:
                 "prefill_chunks": int(self._m_prefill_chunks.value),
                 "prefill_tokens": int(self._m_prefill_tokens.value),
                 "exhausted_events": int(self._m_kv_exhausted.value),
+                "migrate_exports": int(self._m_migrate_exports.value),
+                "migrate_imports": int(self._m_migrate_imports.value),
             })
+            if self._prefix is not None:
+                # bounded chain-head digest: the prefix-affinity routing
+                # signal the router scrapes from /stats
+                kv["chain_heads"] = self._prefix.chain_heads()
+            if self._host_tier is not None:
+                kv["host_restores"] = int(self._m_host_restores.value)
         spec = None
         if self._spec is not None:
             drafted = int(self._m_spec_drafted.value)

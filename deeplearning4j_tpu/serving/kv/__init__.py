@@ -12,6 +12,12 @@ Three parts, one execution model:
 - ``prefill`` — chunked-prefill planning: long prompts ride the
   iteration-granularity batched cadence ``chunk_tokens`` at a time next
   to live decode slots.
+- ``migrate`` — a request's block chain as a transferable value: wire
+  format + validity envelope for replica-to-replica KV handoff
+  (prefill/decode disaggregation).
+- ``hosttier`` — byte-budgeted host-RAM LRU that evicted prefix blocks
+  spill into instead of being dropped; ``PrefixCache.match`` restores
+  spilled chains on a second-chance hit.
 
 Wiring lives in serving/decode.py (``DecodeEngine(kv="paged", ...)``);
 the attention layers' paged step/gather paths are in
@@ -25,12 +31,18 @@ from deeplearning4j_tpu.serving.kv.pool import (BlockPool,  # noqa: F401
                                                 is_pool_path,
                                                 map_slot_leaves,
                                                 map_pool_leaves)
-from deeplearning4j_tpu.serving.kv.prefix import PrefixCache  # noqa: F401
+from deeplearning4j_tpu.serving.kv.prefix import (PrefixCache,  # noqa: F401
+                                                  chain_hashes)
 from deeplearning4j_tpu.serving.kv.prefill import (plan_chunks,  # noqa: F401
                                                    blocks_for_span)
+from deeplearning4j_tpu.serving.kv.migrate import (KVMigrateError,  # noqa: F401
+                                                   pack_chain,
+                                                   unpack_chain)
+from deeplearning4j_tpu.serving.kv.hosttier import HostKVTier  # noqa: F401
 
 __all__ = [
     "BlockPool", "PoolExhaustedError", "SCRATCH_BLOCK", "POOL_KEYS",
     "is_pool_path", "map_slot_leaves", "map_pool_leaves",
-    "PrefixCache", "plan_chunks", "blocks_for_span",
+    "PrefixCache", "chain_hashes", "plan_chunks", "blocks_for_span",
+    "KVMigrateError", "pack_chain", "unpack_chain", "HostKVTier",
 ]

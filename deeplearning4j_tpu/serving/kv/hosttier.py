@@ -1,0 +1,144 @@
+"""Host-memory KV tier: evicted prefix blocks spill to host RAM.
+
+The device pool is small — ``slots * max_blocks + 1`` blocks of HBM — so
+a long tail of conversation histories churns the prefix cache: every LRU
+eviction throws away a block that cost a full prefill chunk to compute,
+and the next hit on that chain pays the prefill again. This tier turns
+eviction into demotion. When the pool evicts a cached block, the engine
+gathers its ``(block_size, H, Dh)`` rows per pool leaf into plain host
+arrays and parks them here under the block's CHAIN HASH — the same
+rolling blake2b key the prefix cache uses, so an entry commits the
+entire token prefix and restore is correct by construction. On a later
+``PrefixCache.match`` miss the cache takes a second chance against this
+tier: the block is re-claimed from the device pool immediately and the
+host→device scatter is deferred to the engine's pre-step batch (the same
+discipline as pending copy-on-write), so the match path never blocks on
+data movement and no new XLA program is ever traced.
+
+The tier is a byte-budgeted LRU keyed by chain hash. It holds host
+memory only — no device buffers, no refcounts — so dropping an entry is
+always safe: the worst case is a cold prefill, which is exactly what
+would have happened without the tier.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor import get_registry
+
+
+class HostTierEntry:
+    """One spilled block: its chain identity plus per-leaf host rows."""
+
+    __slots__ = ("parent", "tokens", "rows", "nbytes")
+
+    def __init__(self, parent: bytes, tokens: Tuple[int, ...],
+                 rows: Dict[str, np.ndarray]):
+        self.parent = parent
+        self.tokens = tokens
+        self.rows = rows
+        self.nbytes = int(sum(a.nbytes for a in rows.values()))
+
+
+class HostKVTier:
+    """Byte-budgeted LRU of spilled prefix blocks, keyed by chain hash."""
+
+    def __init__(self, byte_budget: int, engine: str = "kv"):
+        if byte_budget < 1:
+            raise ValueError(f"byte_budget={byte_budget} must be >= 1")
+        self.byte_budget = int(byte_budget)
+        self._entries: "OrderedDict[bytes, HostTierEntry]" = OrderedDict()
+        self._bytes = 0
+
+        reg = get_registry()
+        lab = {"engine": engine}
+        self._m_blocks = reg.gauge(
+            "dl4jtpu_kv_host_tier_blocks",
+            "Prefix blocks currently held in the host-memory KV tier.",
+            ("engine",)).labels(**lab)
+        self._m_bytes = reg.gauge(
+            "dl4jtpu_kv_host_tier_bytes",
+            "Host memory held by spilled KV blocks (byte-budgeted LRU).",
+            ("engine",)).labels(**lab)
+        self._m_spills = reg.counter(
+            "dl4jtpu_kv_host_spills_total",
+            "Evicted prefix blocks demoted to the host tier instead of "
+            "dropped.", ("engine",)).labels(**lab)
+        self._m_drops = reg.counter(
+            "dl4jtpu_kv_host_drops_total",
+            "Host-tier entries discarded for good (LRU under the byte "
+            "budget, or oversized spills).", ("engine",)).labels(**lab)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def has(self, chain_hash: bytes) -> bool:
+        return chain_hash in self._entries
+
+    # --------------------------------------------------------------- demotion
+    def put(self, chain_hash: bytes, parent: bytes,
+            tokens: Sequence[int], rows: Dict[str, np.ndarray]) -> bool:
+        """Spill one evicted block. Idempotent per chain hash (re-spilling
+        a restored block just refreshes its LRU position — the content is
+        identical by the chain-hash construction). Returns False when the
+        entry alone exceeds the whole budget and had to be dropped."""
+        old = self._entries.pop(chain_hash, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+        entry = HostTierEntry(parent, tuple(int(t) for t in tokens),
+                              {k: np.ascontiguousarray(a)
+                               for k, a in rows.items()})
+        if entry.nbytes > self.byte_budget:
+            self._m_drops.inc()
+            self._gauges()
+            return False
+        while self._entries and self._bytes + entry.nbytes > self.byte_budget:
+            _, lru = self._entries.popitem(last=False)
+            self._bytes -= lru.nbytes
+            self._m_drops.inc()
+        self._entries[chain_hash] = entry
+        self._bytes += entry.nbytes
+        if old is None:
+            self._m_spills.inc()
+        self._gauges()
+        return True
+
+    # -------------------------------------------------------------- promotion
+    def get(self, chain_hash: bytes) -> Optional[HostTierEntry]:
+        """LRU-touching lookup. The entry STAYS in the tier — restore does
+        not consume it, so a restored block evicted again re-spills for
+        free; entries only leave via LRU pressure or ``purge``."""
+        entry = self._entries.get(chain_hash)
+        if entry is not None:
+            self._entries.move_to_end(chain_hash)
+        return entry
+
+    def purge(self) -> int:
+        """Drop everything (weight swaps: spilled KV was computed under
+        the old weights). Returns entries dropped."""
+        n = len(self._entries)
+        if n:
+            self._m_drops.inc(float(n))
+        self._entries.clear()
+        self._bytes = 0
+        self._gauges()
+        return n
+
+    # ------------------------------------------------------------------ intro
+    def stats(self) -> dict:
+        return {"blocks": len(self._entries), "bytes": self._bytes,
+                "byte_budget": self.byte_budget,
+                "spills": int(self._m_spills.value),
+                "drops": int(self._m_drops.value)}
+
+    def _gauges(self) -> None:
+        self._m_blocks.set(float(len(self._entries)))
+        self._m_bytes.set(float(self._bytes))

@@ -1,0 +1,161 @@
+"""KV block-chain migration: a prefill's cache as a transferable value.
+
+DistServe-style disaggregation (prefill-specialized replicas handing
+finished prefills to decode-specialized ones) needs exactly one
+primitive: move a request's live block chain — not the whole pool —
+between replicas such that continued decode on the destination is
+BITWISE what it would have been locally. This module is the wire format;
+the engine supplies the device gathers/scatters (decode.py keeps all
+block movement host-side, so migration adds zero XLA programs).
+
+A payload carries ``n`` chain blocks as one contiguous row-gather per
+pool leaf (``(n, block_size, H, Dh)``, base64 of the raw bytes), the
+token chain that keys them, and a validity envelope in the AOT-bundle
+tradition (exec/aot.py): ``model_signature`` of the serving weights,
+serving precision, block size, and vocab. ``unpack_chain`` validates the
+ENTIRE payload — envelope, leaf set, per-leaf dtype/shape, byte counts,
+and a whole-payload checksum — before returning anything, so a torn or
+mismatched import rejects with the destination pool untouched. Page
+tables never travel: physical block ids are meaningless across pools, so
+the destination allocates fresh blocks and rebinds the chain by
+re-indexing the SAME rolling token hashes (kv/prefix.py) — the continued
+decode is then an ordinary prefix-cache hit, bitwise-equal by the chain
+construction.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+FORMAT = "dl4jtpu/kv-migrate/v1"
+
+# envelope fields that must match the destination engine exactly
+ENVELOPE_FIELDS = ("model_sig", "precision", "block_size", "vocab")
+
+
+class KVMigrateError(Exception):
+    """Import/export rejected; ``reason`` is a bounded label (format /
+    model_sig / precision / block_size / vocab / tokens / leaves / dtype /
+    shape / torn / no_chain / exhausted) for the reject counter."""
+
+    def __init__(self, msg: str, reason: str = "format"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+def _checksum(leaves: Sequence[Tuple[str, bytes]]) -> str:
+    csum = hashlib.blake2b(digest_size=16)
+    for key, raw in leaves:
+        csum.update(key.encode())
+        csum.update(b"|")
+        csum.update(raw)
+    return csum.hexdigest()
+
+
+def pack_chain(rows: Dict[str, np.ndarray], tokens: Sequence[int],
+               envelope: dict) -> dict:
+    """Serialize gathered chain rows (leaf key -> ``(n, bs, H, Dh)``)
+    into a JSON-safe payload. ``tokens`` is the chain's full-block token
+    prefix (``n * block_size`` of them)."""
+    bs = int(envelope["block_size"])
+    toks = [int(t) for t in tokens]
+    n = len(toks) // bs
+    if n < 1 or len(toks) != n * bs:
+        raise KVMigrateError(
+            f"token chain length {len(toks)} is not a positive multiple "
+            f"of block_size {bs}", reason="tokens")
+    leaves: List[dict] = []
+    raws: List[Tuple[str, bytes]] = []
+    for key in sorted(rows):
+        a = np.ascontiguousarray(rows[key])
+        raw = a.tobytes()
+        raws.append((key, raw))
+        leaves.append({"path": key, "dtype": str(a.dtype),
+                       "shape": list(a.shape),
+                       "data": base64.b64encode(raw).decode("ascii")})
+    out = dict(envelope)
+    out.update({"format": FORMAT, "n_blocks": n, "tokens": toks,
+                "leaves": leaves, "checksum": _checksum(raws)})
+    return out
+
+
+def unpack_chain(payload: dict, envelope: dict,
+                 pool_leaves: Dict[str, "np.ndarray"]
+                 ) -> Tuple[List[int], Dict[str, np.ndarray]]:
+    """Validate ``payload`` against the DESTINATION engine's envelope and
+    pool leaf specs; return ``(tokens, rows)`` with rows keyed like
+    ``pool_leaves``. Raises ``KVMigrateError`` — with no side effects on
+    any pool — on every mismatch, malformation, or torn byte."""
+    if not isinstance(payload, dict):
+        raise KVMigrateError("payload must be a JSON object",
+                             reason="format")
+    if payload.get("format") != FORMAT:
+        raise KVMigrateError(
+            f"unknown payload format {payload.get('format')!r} "
+            f"(want {FORMAT!r})", reason="format")
+    for fld in ENVELOPE_FIELDS:
+        if payload.get(fld) != envelope[fld]:
+            raise KVMigrateError(
+                f"envelope mismatch on {fld}: payload has "
+                f"{payload.get(fld)!r}, destination serves "
+                f"{envelope[fld]!r}", reason=fld)
+    bs = int(envelope["block_size"])
+    tokens = payload.get("tokens")
+    n = payload.get("n_blocks")
+    if (not isinstance(n, int) or n < 1 or not isinstance(tokens, list)
+            or len(tokens) != n * bs
+            or not all(isinstance(t, int) for t in tokens)):
+        raise KVMigrateError(
+            f"token chain does not cover n_blocks={n!r} full blocks of "
+            f"{bs}", reason="tokens")
+    vocab = int(envelope["vocab"])
+    if not all(0 <= t < vocab for t in tokens):
+        raise KVMigrateError(
+            f"token ids out of range for vocab {vocab}", reason="tokens")
+    leaves = payload.get("leaves")
+    if not isinstance(leaves, list) or not all(
+            isinstance(l, dict) for l in leaves):
+        raise KVMigrateError("leaves must be a list of objects",
+                             reason="leaves")
+    got = sorted(str(l.get("path")) for l in leaves)
+    want = sorted(pool_leaves)
+    if got != want:
+        raise KVMigrateError(
+            f"pool leaf set mismatch: payload has {got}, destination "
+            f"pool has {want}", reason="leaves")
+    rows: Dict[str, np.ndarray] = {}
+    raws: List[Tuple[str, bytes]] = []
+    for leaf in sorted(leaves, key=lambda l: str(l["path"])):
+        key = str(leaf["path"])
+        dest = pool_leaves[key]
+        dtype = np.dtype(dest.dtype)
+        if leaf.get("dtype") != str(dtype):
+            raise KVMigrateError(
+                f"leaf {key}: payload dtype {leaf.get('dtype')!r} != "
+                f"destination pool dtype {str(dtype)!r}", reason="dtype")
+        shape = tuple(int(s) for s in leaf.get("shape", ()))
+        want_shape = (n,) + tuple(dest.shape[1:])
+        if shape != want_shape:
+            raise KVMigrateError(
+                f"leaf {key}: row shape {shape} != destination "
+                f"{want_shape}", reason="shape")
+        try:
+            raw = base64.b64decode(leaf.get("data", ""), validate=True)
+        except Exception:
+            raise KVMigrateError(
+                f"leaf {key}: undecodable block data", reason="torn")
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if len(raw) != nbytes:
+            raise KVMigrateError(
+                f"leaf {key}: torn payload — {len(raw)} bytes for a "
+                f"{shape} {dtype} gather ({nbytes} expected)",
+                reason="torn")
+        raws.append((key, raw))
+        rows[key] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    if _checksum(raws) != payload.get("checksum"):
+        raise KVMigrateError("payload checksum mismatch", reason="torn")
+    return [int(t) for t in tokens], rows

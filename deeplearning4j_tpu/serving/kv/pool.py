@@ -67,7 +67,20 @@ def map_pool_leaves(fn, tree):
 
 
 class PoolExhaustedError(Exception):
-    """No free or evictable block: admission must wait for a release."""
+    """No free or evictable block: admission must wait for a release.
+
+    Carries the pool occupancy at raise time so /healthz's
+    ``kv_pool_exhausted`` detail can report WHY the pool is stuck —
+    all-live (``in_use`` ≈ usable: capacity problem) reads very
+    differently from all-cached (eviction/spill problem)."""
+
+    def __init__(self, msg: str, need: int = 0, free: int = 0,
+                 in_use: int = 0, cached: int = 0):
+        super().__init__(msg)
+        self.need = int(need)
+        self.free = int(free)
+        self.in_use = int(in_use)
+        self.cached = int(cached)
 
 
 class BlockPool:
@@ -112,6 +125,13 @@ class BlockPool:
             "dl4jtpu_kv_pool_evictions_total",
             "Prefix-cache blocks evicted (LRU) to satisfy an allocation.",
             ("engine",)).labels(**lab)
+        self._m_high_water = reg.gauge(
+            "dl4jtpu_kv_pool_high_water",
+            "Most KV blocks ever simultaneously referenced by live "
+            "requests (pressure signal: high_water near usable means the "
+            "pool, not the cache, is the bottleneck).",
+            ("engine",)).labels(**lab)
+        self.high_water = 0
         self._m_blocks.set(float(self.usable))
         self._m_free.set(float(self.free_count))
 
@@ -149,7 +169,8 @@ class BlockPool:
             raise PoolExhaustedError(
                 f"need {n} blocks, {self.free_count} allocatable "
                 f"({len(self._free)} free + {len(self._evictable)} "
-                f"evictable)")
+                f"evictable)", need=n, free=self.free_count,
+                in_use=self.in_use, cached=self.cached_count)
         out = []
         for _ in range(n):
             if not self._free:
@@ -158,6 +179,7 @@ class BlockPool:
             self._ref[bid] = 1
             out.append(bid)
         self._m_free.set(float(self.free_count))
+        self._note_high_water()
         return out
 
     def incref(self, bid: int) -> None:
@@ -170,6 +192,13 @@ class BlockPool:
             del self._evictable[bid]
         self._ref[bid] += 1
         self._m_free.set(float(self.free_count))
+        self._note_high_water()
+
+    def _note_high_water(self) -> None:
+        n = self.in_use
+        if n > self.high_water:
+            self.high_water = n
+            self._m_high_water.set(float(n))
 
     def decref(self, bid: int) -> None:
         if bid == SCRATCH_BLOCK:

@@ -37,6 +37,25 @@ def _chain_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
     return h.digest()
 
 
+def chain_hashes(tokens: Sequence[int], block_size: int,
+                 limit: Optional[int] = None) -> List[str]:
+    """Hex chain hashes for the claimable full blocks of ``tokens`` —
+    ``(len - 1) // block_size`` of them, mirroring ``match``'s cap. This
+    is the router-side half of prefix affinity: the router hashes a
+    prompt with each replica's block size and compares against the
+    chain-head digests replicas publish in /stats, without ever seeing a
+    KV byte."""
+    n = (len(tokens) - 1) // block_size
+    if limit is not None:
+        n = min(n, limit)
+    out: List[str] = []
+    h = _ROOT
+    for j in range(n):
+        h = _chain_hash(h, tokens[j * block_size:(j + 1) * block_size])
+        out.append(h.hex())
+    return out
+
+
 class PrefixCache:
     """Rolling-hash-chain index over cached pool blocks.
 
@@ -47,7 +66,7 @@ class PrefixCache:
     back into ``_drop`` so the index never points at a recycled block.
     """
 
-    def __init__(self, pool: BlockPool):
+    def __init__(self, pool: BlockPool, tier=None):
         self.pool = pool
         self._by_hash: Dict[bytes, int] = {}        # chain hash -> bid
         self._by_bid: Dict[int, bytes] = {}
@@ -55,6 +74,16 @@ class PrefixCache:
         # block after a matched chain (copy-on-write sources)
         self._children: Dict[bytes, List[Tuple[int, Tuple[int, ...]]]] = {}
         self._child_of: Dict[int, bytes] = {}
+        # host tier (kv/hosttier.py) plus the engine-owned data movers:
+        # spill_fn(hash, parent, tokens, bid) gathers a block's device
+        # rows into the tier on eviction; restore_fn(hash, tokens) claims
+        # a fresh pool block for a tier hit (returning its bid, or None
+        # when the pool can't even spare one) and queues the host→device
+        # scatter on the engine's pre-step batch
+        self.tier = tier
+        self.spill_fn = None
+        self.restore_fn = None
+        self._spill_enabled = True
         pool.on_evict = self._drop
 
     def __len__(self) -> int:
@@ -79,9 +108,25 @@ class PrefixCache:
         shared: List[int] = []
         h = _ROOT
         for j in range(limit):
-            nxt = _chain_hash(h, prompt[j * bs:(j + 1) * bs])
+            tok = prompt[j * bs:(j + 1) * bs]
+            nxt = _chain_hash(h, tok)
             bid = self._by_hash.get(nxt)
             if bid is None:
+                # second chance: the chain may continue in the host tier.
+                # restore_fn claims a fresh block NOW (refcount 1 — no
+                # incref below, the alloc IS this request's claim) and
+                # defers the data scatter; on pool pressure it returns
+                # None and the walk ends as a plain miss.
+                if (self.tier is not None and self.restore_fn is not None
+                        and self.tier.has(nxt)):
+                    bid = self.restore_fn(
+                        nxt, tuple(int(t) for t in tok))
+                    if bid is not None:
+                        self._index(nxt, bid, tok, h)
+                        self.pool.mark_cached(bid)
+                        shared.append(bid)
+                        h = nxt
+                        continue
                 break
             self.pool.incref(bid)
             shared.append(bid)
@@ -122,30 +167,63 @@ class PrefixCache:
                 if bid in self._by_bid:      # bid already published under
                     h = nxt                  # another chain — keep it
                     continue
-                self._by_hash[nxt] = bid
-                self._by_bid[bid] = nxt
-                tok = tuple(int(t) for t in prompt[j * bs:(j + 1) * bs])
-                self._children.setdefault(h, []).append((bid, tok))
-                self._child_of[bid] = h
+                self._index(nxt, bid, prompt[j * bs:(j + 1) * bs], h)
                 self.pool.mark_cached(bid)
                 added += 1
             h = nxt
         return added
 
+    def _index(self, chain_hash: bytes, bid: int,
+               tokens: Sequence[int], parent: bytes) -> None:
+        self._by_hash[chain_hash] = bid
+        self._by_bid[bid] = chain_hash
+        tok = tuple(int(t) for t in tokens)
+        self._children.setdefault(parent, []).append((bid, tok))
+        self._child_of[bid] = parent
+
+    def chain_heads(self, limit: int = 64) -> List[str]:
+        """Bounded digest of published chain hashes (hex, newest last) —
+        what a replica advertises in /stats for prefix-affinity routing.
+        Bounded because the digest rides on every stats scrape; the
+        newest entries are the likeliest to survive LRU anyway."""
+        heads = [h.hex() for h in self._by_hash]
+        return heads[-limit:] if limit is not None else heads
+
     # -------------------------------------------------------------- eviction
     def _drop(self, bid: int) -> None:
-        """Pool eviction callback: forget every index entry for ``bid``."""
+        """Pool eviction callback: forget every index entry for ``bid``,
+        spilling the block to the host tier first when one is attached
+        (demotion instead of loss — kv/hosttier.py)."""
         h = self._by_bid.pop(bid, None)
-        if h is not None:
-            self._by_hash.pop(h, None)
         parent = self._child_of.pop(bid, None)
+        tok = None
         if parent is not None:
             kids = self._children.get(parent)
             if kids is not None:
+                for b, t in kids:
+                    if b == bid:
+                        tok = t
                 kids[:] = [(b, t) for b, t in kids if b != bid]
                 if not kids:
                     del self._children[parent]
+        if h is not None:
+            self._by_hash.pop(h, None)
+            if (self._spill_enabled and self.tier is not None
+                    and self.spill_fn is not None and tok is not None):
+                self.spill_fn(h, parent if parent is not None else _ROOT,
+                              tok, bid)
 
     def clear(self) -> int:
-        """Flush every ref-0 entry through the pool (weight swaps)."""
-        return self.pool.flush_cached()
+        """Flush every ref-0 entry through the pool (weight swaps). The
+        host tier is purged and spilling is DISABLED for the flush: the
+        evicted KV was computed under the old weights, so letting the
+        flush demote it would resurrect stale blocks — the exact bug a
+        stale chain-head digest then amplifies fleet-wide via affinity
+        routing. The advertised digest empties with ``_by_hash``."""
+        if self.tier is not None:
+            self.tier.purge()
+        self._spill_enabled = False
+        try:
+            return self.pool.flush_cached()
+        finally:
+            self._spill_enabled = True

@@ -78,8 +78,19 @@ def build_model(name: str):
                 .set_input_type(InputType.recurrent(CHAR_VOCAB))
                 .build())
         return MultiLayerNetwork(conf).init()
+    if name == "tinyattn":
+        # attention-only decode state: the model disaggregated-serving
+        # tests and benches need — paged KV with prefix_cache works (no
+        # recurrent carries), so chains can be cached, migrated between
+        # replicas, and spilled to the host tier. Same vocabulary as
+        # charlstm so the fleet fixtures reuse their prompt generators.
+        from deeplearning4j_tpu.zoo.simple import TinyTransformer
+        return TinyTransformer(vocab_size=CHAR_VOCAB, n_layers=2,
+                               d_model=32, n_heads=4, max_len=256,
+                               seed=42).init()
     raise ValueError(
-        f"unknown replica model {name!r} (mlp | charlstm | charlstm-draft)")
+        f"unknown replica model {name!r} "
+        f"(mlp | charlstm | charlstm-draft | tinyattn)")
 
 
 def build_server(model_name: str = "charlstm", port: int = 0,
@@ -89,7 +100,9 @@ def build_server(model_name: str = "charlstm", port: int = 0,
                  kv_block_size: int = 16, kv_blocks: Optional[int] = None,
                  prefix_cache: bool = False,
                  chunk_tokens: Optional[int] = None,
-                 spec_draft: Optional[str] = None, spec_k: int = 4):
+                 spec_draft: Optional[str] = None, spec_k: int = 4,
+                 role: str = "mixed",
+                 host_kv_bytes: Optional[int] = None):
     """Assemble (but don't start) a replica InferenceServer. ``charlstm``
     serves both /predict and /generate; ``mlp`` is predict-only.
     ``precision`` (None = the executor policy / DL4JTPU_PRECISION) puts
@@ -103,14 +116,20 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     prefix cache cannot share. ``spec_draft`` names a draft model (e.g.
     ``charlstm-draft``) to switch /generate to speculative decoding with
     ``spec_k`` tokens proposed per tick (docs/DECODING.md "Speculative
-    decoding"); output stays bitwise-identical to the plain engine."""
+    decoding"); output stays bitwise-identical to the plain engine.
+    ``tinyattn`` (attention-only decode state) serves /generate with
+    full paged-KV features: prefix_cache, /kv/export + /kv/import
+    migration, and — with ``host_kv_bytes`` — the host-memory KV tier.
+    ``role`` declares the replica's disaggregation specialization
+    (prefill | decode | mixed), advertised via /stats for the router's
+    role-aware placement."""
     from deeplearning4j_tpu.serving.decode import DecodeEngine
     from deeplearning4j_tpu.serving.engine import InferenceEngine
     from deeplearning4j_tpu.serving.server import InferenceServer
     net = build_model(model_name)
     eng = InferenceEngine(net, precision=precision)
     dec = None
-    if model_name == "charlstm":
+    if model_name in ("charlstm", "tinyattn"):
         spec = None
         if spec_draft is not None:
             from deeplearning4j_tpu.serving.spec import SpecConfig
@@ -119,14 +138,16 @@ def build_server(model_name: str = "charlstm", port: int = 0,
                            max_queue=max_queue, precision=precision,
                            kv=kv, kv_block_size=kv_block_size,
                            kv_blocks=kv_blocks, prefix_cache=prefix_cache,
-                           chunk_tokens=chunk_tokens, spec=spec)
+                           chunk_tokens=chunk_tokens,
+                           host_kv_bytes=host_kv_bytes, spec=spec)
     injector = None
     if chaos:
         from deeplearning4j_tpu.resilience.faults import ServerFaultInjector
         injector = ServerFaultInjector()
     return InferenceServer(net, port=port, max_latency_ms=max_latency_ms,
                            max_queue=max_queue, engine=eng,
-                           decode_engine=dec, fault_injector=injector)
+                           decode_engine=dec, fault_injector=injector,
+                           role=role)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -137,7 +158,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--port-file", default=None,
                         help="write the bound port here once listening")
     parser.add_argument("--model", default="charlstm",
-                        choices=("mlp", "charlstm"))
+                        choices=("mlp", "charlstm", "tinyattn"))
+    parser.add_argument("--role", default="mixed",
+                        choices=("prefill", "decode", "mixed"),
+                        help="disaggregation role advertised in /stats: "
+                             "the router prefers prefill/mixed replicas "
+                             "for fresh prefills and steers shared-prefix "
+                             "fan-out by chain affinity")
+    parser.add_argument("--host-kv-bytes", type=int, default=None,
+                        help="host-memory KV tier byte budget (paged + "
+                             "--prefix-cache only): evicted prefix blocks "
+                             "spill to host RAM and restore on later hits")
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--max-len", type=int, default=64)
     parser.add_argument("--max-queue", type=int, default=256)
@@ -208,7 +239,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                        kv_blocks=args.kv_blocks,
                        prefix_cache=args.prefix_cache,
                        chunk_tokens=args.chunk_tokens,
-                       spec_draft=args.spec_draft, spec_k=args.spec_k)
+                       spec_draft=args.spec_draft, spec_k=args.spec_k,
+                       role=args.role, host_kv_bytes=args.host_kv_bytes)
     # warmup BEFORE the serve loops start so REPLICA_READY / the port-file
     # handshake mean genuinely ready-to-serve: with --aot this is a
     # millisecond restore, without it the full trace-and-save
@@ -285,6 +317,8 @@ class ReplicaProcess:
                  kv_blocks: Optional[int] = None, prefix_cache: bool = False,
                  chunk_tokens: Optional[int] = None,
                  spec_draft: Optional[str] = None, spec_k: int = 4,
+                 role: str = "mixed",
+                 host_kv_bytes: Optional[int] = None,
                  aot: Optional[str] = None,
                  env: Optional[dict] = None):
         self.workdir = workdir
@@ -302,6 +336,8 @@ class ReplicaProcess:
         self.chunk_tokens = chunk_tokens
         self.spec_draft = spec_draft
         self.spec_k = spec_k
+        self.role = role
+        self.host_kv_bytes = host_kv_bytes
         # span tracing in the child (GET /trace serves its ring buffer)
         self.trace = trace
         # mutable: rolling restarts set this to the latest promoted
@@ -350,6 +386,10 @@ class ReplicaProcess:
                 cmd.append("--prefix-cache")
             if self.chunk_tokens is not None:
                 cmd.extend(["--chunk-tokens", str(self.chunk_tokens)])
+            if self.host_kv_bytes is not None:
+                cmd.extend(["--host-kv-bytes", str(self.host_kv_bytes)])
+        if self.role != "mixed":
+            cmd.extend(["--role", self.role])
         if self.spec_draft is not None:
             cmd.extend(["--spec-draft", self.spec_draft,
                         "--spec-k", str(self.spec_k)])

@@ -65,6 +65,7 @@ from deeplearning4j_tpu.monitor import get_registry, trace
 from deeplearning4j_tpu.monitor import tracing
 from deeplearning4j_tpu.monitor.slo import BurnRateSLO
 from deeplearning4j_tpu.serving.client import InferenceClient
+from deeplearning4j_tpu.serving.kv.prefix import chain_hashes
 
 __all__ = ["Router", "RetryBudget", "ReplicaState"]
 
@@ -156,6 +157,13 @@ class _Replica:
         self.ejected_until = 0.0
         self.backoff = 0.0
         self.lock = threading.Lock()
+        # disaggregation state learned from /stats (refresh_affinity):
+        # the replica's declared role and its advertised KV chain heads —
+        # the prefix-affinity routing signal. Stale values only cost a
+        # fallback to plain least-outstanding, never correctness.
+        self.role = "mixed"
+        self.chain_heads: frozenset = frozenset()
+        self.kv_block_size: Optional[int] = None
 
     def routable(self) -> bool:
         return (self.state != ReplicaState.EJECTED
@@ -279,6 +287,9 @@ class Router:
                  max_outstanding: Optional[int] = None,
                  hold_for_capacity_s: float = 0.0,
                  wake_hook: Optional[Callable[[], None]] = None,
+                 prefix_affinity: bool = True,
+                 affinity_max_chain: int = 32,
+                 affinity_slack: int = 2,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         if not upstreams and hold_for_capacity_s <= 0:
@@ -306,6 +317,14 @@ class Router:
         self.max_outstanding = max_outstanding
         self.hold_for_capacity_s = float(hold_for_capacity_s)
         self.wake_hook = wake_hook
+        # prefix-affinity routing (docs/SERVING_TIER.md "Disaggregation"):
+        # /generate primaries prefer the replica already advertising this
+        # prompt's KV chain heads — bounded by ``affinity_slack`` extra
+        # outstanding requests so affinity never starves load balancing,
+        # and always layered BENEATH the health state machine.
+        self.prefix_affinity = bool(prefix_affinity)
+        self.affinity_max_chain = int(affinity_max_chain)
+        self.affinity_slack = int(affinity_slack)
         self._replicas: Dict[str, _Replica] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
@@ -367,6 +386,16 @@ class Router:
             "dl4jtpu_router_probes_total",
             "Active /healthz probes. result: ok | degraded | draining | "
             "error.", ("router", "replica", "result"))
+        self._m_affinity = reg.counter(
+            "dl4jtpu_router_affinity_requests_total",
+            "Prefix-affinity decisions on /generate primary picks. "
+            "outcome: hit (routed to a replica advertising the prompt's "
+            "chain heads) | miss (no eligible replica covered the prefix; "
+            "fell back to least-outstanding).", ("router", "outcome"))
+        self._m_aff_refreshes = reg.counter(
+            "dl4jtpu_router_affinity_refreshes_total",
+            "Per-replica chain-head/role refreshes pulled from /stats "
+            "(piggybacked on the probe sweep).", ("router",))
         self._m_latency = reg.histogram(
             "dl4jtpu_router_upstream_latency_seconds",
             "Latency of successful upstream attempts (feeds the p95 hedge "
@@ -518,6 +547,7 @@ class Router:
     def probe_once(self) -> None:
         """One active probe sweep (the loop calls this every
         ``probe_interval``; tests call it directly under a fake clock)."""
+        alive = []
         for rep in list(self._replicas.values()):
             if rep.admin_down:
                 continue
@@ -540,6 +570,7 @@ class Router:
             rep.draining = False
             rep.degraded = (status == "degraded")
             if status in ("ok", "degraded"):
+                alive.append(rep)
                 with rep.lock:
                     if rep.state == ReplicaState.EJECTED:
                         # re-admit provisionally; the first real success
@@ -553,6 +584,7 @@ class Router:
                         rep.backoff = 0.0
             else:
                 self._note_failure(rep, "probe")
+        self.refresh_affinity(alive)
 
     def _probe_loop(self) -> None:
         while not self._stop.is_set():
@@ -562,8 +594,77 @@ class Router:
                 pass
             self._sleep(self.probe_interval)
 
+    # ------------------------------------------------------- prefix affinity
+    def refresh_affinity(self, replicas=None) -> None:
+        """Pull each replica's declared role and advertised KV chain heads
+        from ``/stats`` (the bounded digest DecodeEngine.stats publishes).
+        Rides the probe sweep; tests and benches call it directly. A
+        replica whose stats call fails keeps its last-known heads —
+        staleness only costs a fallback to least-outstanding."""
+        if not self.prefix_affinity:
+            return
+        if replicas is None:
+            replicas = [r for r in self._replicas.values()
+                        if not r.admin_down
+                        and r.state != ReplicaState.EJECTED]
+        for rep in replicas:
+            try:
+                st = rep.probe_client.stats()
+            except Exception:   # noqa: BLE001 — stale heads beat no heads
+                continue
+            rep.role = str(st.get("role") or "mixed")
+            kv = (st.get("decode") or {}).get("kv") or {}
+            rep.chain_heads = frozenset(
+                str(h) for h in (kv.get("chain_heads") or []))
+            rep.kv_block_size = kv.get("block_size")
+            self._m_aff_refreshes.labels(router=self.id).inc()
+
+    def _affinity_hint(self, path: str, body: bytes) -> Optional[dict]:
+        """Score replicas by how deep their advertised chain heads cover
+        this prompt's rolling block-hash chain (the same blake2b chain the
+        replicas' PrefixCache publishes, computed router-side). Returns
+        ``{url: depth}`` with depth >= 1 for covering replicas, ``{}``
+        when nobody covers any prefix (counts as a miss), or None when
+        affinity cannot apply — disabled, non-/generate, no advertised
+        heads, unparseable body — which bypasses the hit/miss counter."""
+        if not self.prefix_affinity or path != "/generate":
+            return None
+        with self._lock:
+            reps = [(r.url, r.chain_heads, r.kv_block_size)
+                    for r in self._replicas.values() if r.chain_heads]
+        if not reps:
+            return None
+        try:
+            payload = json.loads(body.decode())
+            toks = tuple(int(t) for t in payload["tokens"])
+        except Exception:   # noqa: BLE001 — replicas answer 400 for junk
+            return None
+        if not toks:
+            return None
+        by_bs: Dict[int, List[str]] = {}
+        hint: Dict[str, int] = {}
+        for url, heads, bs in reps:
+            try:
+                bs = int(bs)
+            except (TypeError, ValueError):
+                continue
+            if bs <= 0:
+                continue
+            if bs not in by_bs:
+                by_bs[bs] = chain_hashes(toks, bs,
+                                         limit=self.affinity_max_chain)
+            depth = 0
+            for h in by_bs[bs]:
+                if h not in heads:
+                    break           # a chain hit commits the WHOLE prefix
+                depth += 1
+            if depth:
+                hint[url] = depth
+        return hint
+
     # -------------------------------------------------------------- selection
-    def _pick(self, exclude) -> Optional[_Replica]:
+    def _pick(self, exclude, hint=None,
+              want_prefill: bool = False) -> Optional[_Replica]:
         with self._lock:
             cands = [r for r in self._replicas.values()
                      if r.routable() and r.url not in exclude]
@@ -580,6 +681,28 @@ class Router:
             fresh = [r for r in cands if not r.degraded]
             pool = fresh or cands
             least = min(r.outstanding for r in pool)
+            if hint:
+                # prefix affinity: prefer the replica already holding the
+                # deepest prefix of this prompt's chain — but never one
+                # more than ``affinity_slack`` requests busier than the
+                # least-loaded candidate. Affinity is a tiebreak UNDER
+                # the health/load model, never an override of it.
+                aff = [r for r in pool if hint.get(r.url)
+                       and r.outstanding <= least + self.affinity_slack]
+                if aff:
+                    deepest = max(hint[r.url] for r in aff)
+                    aff = [r for r in aff if hint[r.url] == deepest]
+                    least_a = min(r.outstanding for r in aff)
+                    best = [r for r in aff if r.outstanding == least_a]
+                    return best[next(self._rr) % len(best)]
+            if want_prefill:
+                # disaggregated fleet, fresh prompt (no affinity winner):
+                # steer the cold prefill away from decode-dedicated
+                # replicas when any other kind is available
+                pref = [r for r in pool if r.role != "decode"]
+                if pref:
+                    pool = pref
+                    least = min(r.outstanding for r in pool)
             best = [r for r in pool if r.outstanding == least]
             return best[next(self._rr) % len(best)]   # round-robin the tie
 
@@ -686,7 +809,9 @@ class Router:
                     trace.span("route", path=path):
                 expires = self._expiry(body)
                 hedge = self.hedge_enabled and path == "/predict"
-                return self._forward(path, body, rid, expires, hedge)
+                hint = self._affinity_hint(path, body)
+                return self._forward(path, body, rid, expires, hedge,
+                                     hint=hint)
         finally:
             self._release(tenant)
 
@@ -745,7 +870,7 @@ class Router:
         return "5xx"
 
     def _forward(self, path: str, body: bytes, rid: str,
-                 expires: Optional[float], hedge: bool):
+                 expires: Optional[float], hedge: bool, hint=None):
         results: "queue.Queue" = queue.Queue()
         live: List[_Attempt] = []
         tried = set()
@@ -764,7 +889,8 @@ class Router:
             self._m_requests.labels(router=self.id, path=path,
                                     outcome=tag).inc()
 
-        primary = self._pick(tried)
+        want_prefill = self.prefix_affinity and path == "/generate"
+        primary = self._pick(tried, hint=hint, want_prefill=want_prefill)
         if primary is None:
             # scale-to-zero: hold the request briefly while the autoscaler
             # wakes a replica (AOT restore makes this a sub-second wait)
@@ -774,6 +900,12 @@ class Router:
             self._m_sheds.labels(router=self.id, reason="no_replicas").inc()
             return self._err(503, "no_healthy_replicas",
                              "no routable replica", rid)
+        if hint is not None:
+            # counted on the primary pick only — failover/hedge picks are
+            # health decisions, not affinity decisions
+            self._m_affinity.labels(
+                router=self.id,
+                outcome="hit" if hint.get(primary.url) else "miss").inc()
         launch(primary)
         hedge_at = (time.perf_counter() + self._hedge_delay_s()
                     if hedge else None)
@@ -951,7 +1083,9 @@ class Router:
                          "degraded": r.degraded,
                          "draining": r.draining,
                          "admin_down": r.admin_down,
-                         "probe_backoff_s": r.backoff}
+                         "probe_backoff_s": r.backoff,
+                         "role": r.role,
+                         "affinity_heads": len(r.chain_heads)}
         return {"id": self.id,
                 "replicas": reps,
                 "retry_budget_balance": round(self.budget.balance, 3),

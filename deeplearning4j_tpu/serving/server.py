@@ -55,10 +55,12 @@ from deeplearning4j_tpu.resilience.errors import (
     InjectedFaultError, ServerOverloadedError, WeightSwapError)
 from deeplearning4j_tpu.serving.batcher import MicroBatcher
 from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.kv import KVMigrateError
 
 _KNOWN_PATHS = ("/predict", "/generate", "/warmup", "/stats", "/metrics",
                 "/healthz", "/chaos", "/admin/swap", "/trace", "/programs",
-                "/admin/profile", "/train/diagnostics")
+                "/admin/profile", "/train/diagnostics", "/kv/export",
+                "/kv/import")
 
 
 def _http_metrics():
@@ -210,6 +212,10 @@ class _Handler(BaseHTTPRequestHandler):
                     else:
                         srv.fault_injector.configure(**payload)
                         self._json({"chaos": srv.fault_injector.describe()})
+                elif path == "/kv/export":
+                    self._kv_export(srv, payload)
+                elif path == "/kv/import":
+                    self._kv_import(srv, payload)
                 elif path == "/admin/swap":
                     self._admin_swap(srv, payload)
                 elif path == "/admin/profile":
@@ -234,6 +240,10 @@ class _Handler(BaseHTTPRequestHandler):
             except WeightSwapError as e:
                 # structured rejection: the live engines were never touched
                 self._error(409, "weight_mismatch", str(e))
+            except KVMigrateError as e:
+                # same discipline: validation rejected the payload before
+                # the destination pool was touched
+                self._error(409, "kv_migrate_rejected", str(e))
             except (CorruptCheckpointError, FileNotFoundError) as e:
                 self._error(400, "bad_checkpoint", str(e))
             except InjectedFaultError as e:
@@ -331,6 +341,44 @@ class _Handler(BaseHTTPRequestHandler):
                    extra_headers={
                        "x-model-version": str(srv.engine.model_version)})
 
+    def _kv_gate(self, srv):
+        """Both migration endpoints require a paged decode engine with a
+        prefix cache (the chain index IS the migration unit)."""
+        dec = srv.decode_engine
+        if dec is None or getattr(dec, "_prefix", None) is None:
+            self._error(404, "not_found",
+                        "KV migration requires a paged decode engine with "
+                        "prefix_cache on this server")
+            return None
+        return dec
+
+    def _kv_export(self, srv, payload):
+        """POST /kv/export {"tokens": [...]} — serialize the cached block
+        chain covering the prompt's full blocks (disaggregation: the
+        prefill replica's half of a handoff)."""
+        dec = self._kv_gate(srv)
+        if dec is None:
+            return
+        try:
+            tokens = payload["tokens"]
+        except KeyError:
+            raise BadRequestError("payload missing 'tokens'") from None
+        if (not isinstance(tokens, list)
+                or not all(isinstance(t, int) for t in tokens)):
+            raise BadRequestError("'tokens' must be a list of token ids")
+        self._json(dec.kv_export(tokens), extra_headers={
+            "x-model-version": str(dec.model_version)})
+
+    def _kv_import(self, srv, payload):
+        """POST /kv/import <export payload> — restore a migrated chain
+        into this replica's pool (the decode replica's half). Envelope or
+        integrity mismatches answer 409 with the pool untouched."""
+        dec = self._kv_gate(srv)
+        if dec is None:
+            return
+        self._json(dec.kv_import(payload), extra_headers={
+            "x-model-version": str(dec.model_version)})
+
     def _generate(self, srv, payload):
         if srv.decode_engine is None:
             self._error(404, "not_found",
@@ -376,7 +424,16 @@ class InferenceServer:
                  request_timeout_ms: Optional[float] = None,
                  decode_engine=None, fault_injector=None,
                  health_hook=None, request_mirror=None,
-                 flight_recorder=None):
+                 flight_recorder=None, role: str = "mixed"):
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'mixed', got {role!r}")
+        # disaggregation role advertised in /stats: a routing PREFERENCE
+        # the fleet router reads (prefill-specialized replicas take fresh
+        # prefills, decode-specialized ones take migrated chains) — the
+        # server itself serves every endpoint regardless of role, so a
+        # degraded fleet can always fail over across roles
+        self.role = role
         self.engine = engine or InferenceEngine(model)
         # serving/decode.DecodeEngine for POST /generate (None = endpoint
         # answers 404; predict-only servers don't pay for decode slots)
@@ -517,6 +574,7 @@ class InferenceServer:
         out = {"engine": self.engine.stats(),
                "batcher": self.batcher.stats(),
                "health": self.health(),
+               "role": self.role,
                "model_version": self.engine.model_version,
                "last_error": self.last_error}
         if self.decode_engine is not None:

@@ -6,8 +6,9 @@ and keep every COUNT/ACCURACY assertion live — the accuracy-delta bars,
 the int8 ≤ 0.30x weight-bytes ratio, the one-program-per-precision pin,
 and the autotuned-ladder compile/pad-waste claims. Only the wall-clock
 ratio assertions (int8 decode ≥ 1.2x bf16, speculative decode ≥ 1.8x
-plain) are full-mode-only: CPU timings of a dequant-on-the-fly path or
-a tiny draft model prove nothing about the TPU's memory-bound decode
+plain, affinity fan-out ≥ 1.5x random routing, host-tier restore ≥
+recompute) are full-mode-only: CPU timings of a dequant-on-the-fly path
+or a tiny draft model prove nothing about the TPU's memory-bound decode
 step.
 """
 
@@ -74,6 +75,34 @@ def test_kv_prefix_row_fast():
     assert row["prefix_hits"] == 3                  # R-1 with fast R=4
     assert row["prefix_tokens_saved"] >= 3 * 16
     assert row["cow_copies"] == 0                   # boundary divergence
+
+
+def test_kv_affinity_row_fast():
+    row = bench.bench_kv_affinity(fast=True)
+    # the function itself asserts zero failed requests, bitwise parity of
+    # every routed output with a local standalone engine, the migration
+    # into both decode replicas, and pool drain; the ≥1.5x effective
+    # prefill throughput bar is full-mode-only
+    assert row["unit"] == "x"
+    assert row["outputs_bitwise_equal"] is True
+    assert row["failed_requests"] == 0
+    assert row["migrate_imports"] == 2             # both decode replicas
+    assert row["decode_replica_prefix_hits"] >= 1
+    assert row["affinity_hits"] >= 1
+
+
+def test_kv_tier_row_fast():
+    row = bench.bench_kv_tier(fast=True)
+    # the function itself asserts bitwise output parity across the
+    # tier-on/tier-off arms, spills + restores observed, the one-program
+    # pin (restores are host-side block movement, ZERO new XLA programs),
+    # and pool drain; the throughput and p99 bars are full-mode-only
+    assert row["unit"] == "x"
+    assert row["outputs_bitwise_equal"] is True
+    assert row["host_spills"] > 0
+    assert row["host_restores"] > 0
+    assert row["pool_high_water"] > 0
+    assert row["short_decode_p99_ms_tier"] > 0
 
 
 def test_spec_decode_row_fast():

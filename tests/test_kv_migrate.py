@@ -1,0 +1,302 @@
+"""KV block migration + host-memory KV tier (serving/kv/migrate.py,
+serving/kv/hosttier.py — docs/SERVING_TIER.md "Disaggregation").
+
+The load-bearing claims pinned here:
+- a migrated block chain continues decoding BITWISE-identically on the
+  destination replica, at f32 AND bf16 compute, including chains whose
+  tail block was produced by copy-on-write;
+- the validity envelope rejects payloads from a different architecture
+  (model_sig), block size, or element dtype, and a torn/corrupted
+  payload is rejected with the destination pool completely untouched;
+- evicted prefix blocks spill to the host tier and restore on a later
+  chain hit with bitwise-identical output and ZERO new XLA programs;
+- a weight swap purges the host tier AND the advertised chain-head
+  digest (stale-affinity regression);
+- ``PoolExhaustedError`` carries the occupancy detail /healthz reports.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.serving import DecodeEngine
+from deeplearning4j_tpu.serving.kv import (BlockPool, HostKVTier,
+                                           KVMigrateError,
+                                           PoolExhaustedError)
+from deeplearning4j_tpu.zoo.simple import TinyTransformer
+
+V = 13
+
+
+def _transformer(max_len=64, compute_dtype=None, seed=7, n_layers=2):
+    kw = {"compute_dtype": compute_dtype} if compute_dtype else {}
+    return TinyTransformer(vocab_size=V, n_layers=n_layers, d_model=32,
+                           n_heads=4, max_len=max_len, seed=seed,
+                           **kw).init()
+
+
+def _prompts(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, V, size=n))) for n in sizes]
+
+
+def _paged(net, slots=2, max_len=64, bs=8, **kw):
+    return DecodeEngine(net, slots=slots, max_len=max_len, kv="paged",
+                        kv_block_size=bs, prefix_cache=True,
+                        chunk_tokens=8, **kw).start()
+
+
+def _pool_snapshot(eng):
+    p = eng._pool
+    return (p.in_use, p.free_count, p.cached_count)
+
+
+def _counter(name, **labels):
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    want = tuple(str(labels[k]) for k in fam.labelnames)
+    return sum(child.value for key, child in fam.children()
+               if key == want)
+
+
+def _retamper(payload):
+    """Deep copy through JSON — exactly what a wire transfer does."""
+    return json.loads(json.dumps(payload))
+
+
+# ------------------------------------------------------------- migration
+
+@pytest.mark.parametrize("dtype", [None, "bfloat16"],
+                         ids=["f32", "bf16"])
+def test_migrate_roundtrip_bitwise(dtype):
+    src = _paged(_transformer(compute_dtype=dtype))
+    dst = _paged(_transformer(compute_dtype=dtype))
+    prompt = _prompts([20])[0]
+    try:
+        ref = src.generate(prompt, max_new_tokens=6)
+        payload = src.kv_export(prompt)
+        assert payload["n_blocks"] == 2          # (20-1)//8 claimable
+        out = dst.kv_import(payload)
+        assert out["imported_blocks"] == 2
+        assert out["duplicate_blocks"] == 0
+        got = dst.generate(prompt, max_new_tokens=6)
+        assert got["tokens"] == ref["tokens"]    # continued decode, bitwise
+        st = dst.stats()["kv"]
+        assert st["prefix_hits"] >= 1            # it really used the chain
+        assert st["migrate_imports"] == 1
+        # the destination now serves rows bitwise-equal to the payload:
+        # re-export the same chain and compare raw leaf bytes
+        back = dst.kv_export(prompt)
+        for a, b in zip(payload["leaves"], back["leaves"]):
+            assert a["path"] == b["path"]
+            assert a["data"] == b["data"]
+        # re-importing the same payload is a no-op (first-writer-wins)
+        again = dst.kv_import(_retamper(payload))
+        assert again["imported_blocks"] == 0
+        assert again["duplicate_blocks"] == 2
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_migrate_midchain_cow_chain():
+    src = _paged(_transformer())
+    dst = _paged(_transformer())
+    p1 = _prompts([20], seed=1)[0]
+    p2 = p1[:12] + _prompts([8], seed=2)[0]      # diverges MID block 1
+    try:
+        r1 = src.generate(p1, max_new_tokens=6)
+        r2 = src.generate(p2, max_new_tokens=6)
+        assert src.stats()["kv"]["cow_copies"] >= 1
+        # p2's chain tail block was written via copy-on-write; its
+        # migrated bytes must still continue decode exactly
+        out = dst.kv_import(src.kv_export(p2))
+        assert out["imported_blocks"] == 2
+        assert dst.generate(p2, max_new_tokens=6)["tokens"] == r2["tokens"]
+        assert dst.generate(p1, max_new_tokens=6)["tokens"] == r1["tokens"]
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_migrate_envelope_rejections():
+    src = _paged(_transformer())
+    prompt = _prompts([20])[0]
+    try:
+        src.generate(prompt, max_new_tokens=4)
+        payload = src.kv_export(prompt)
+    finally:
+        src.stop()                               # the payload is a value
+
+    # different architecture → model_sig mismatch
+    dst = _paged(_transformer(n_layers=1))
+    try:
+        with pytest.raises(KVMigrateError) as ei:
+            dst.kv_import(_retamper(payload))
+        assert ei.value.reason == "model_sig"
+        assert _pool_snapshot(dst)[0] == 0
+    finally:
+        dst.stop()
+
+    # same model, different block size
+    dst = _paged(_transformer(), bs=16)
+    try:
+        with pytest.raises(KVMigrateError) as ei:
+            dst.kv_import(_retamper(payload))
+        assert ei.value.reason == "block_size"
+    finally:
+        dst.stop()
+
+    dst = _paged(_transformer())
+    try:
+        bad = _retamper(payload)
+        for leaf in bad["leaves"]:
+            leaf["dtype"] = "float64"            # wire says f64, pool is f32
+        with pytest.raises(KVMigrateError) as ei:
+            dst.kv_import(bad)
+        assert ei.value.reason == "dtype"
+
+        bad = _retamper(payload)
+        bad["vocab"] = V + 1
+        with pytest.raises(KVMigrateError) as ei:
+            dst.kv_import(bad)
+        assert ei.value.reason == "vocab"
+
+        # every rejection was counted under its reason and none of them
+        # touched the pool — the good payload still imports cleanly after
+        assert _counter("dl4jtpu_kv_migrate_rejects_total",
+                        engine=dst.id, reason="dtype") == 1
+        assert _counter("dl4jtpu_kv_migrate_rejects_total",
+                        engine=dst.id, reason="vocab") == 1
+        assert _pool_snapshot(dst) == (0, dst._pool.usable, 0)
+        assert dst.kv_import(payload)["imported_blocks"] == 2
+    finally:
+        dst.stop()
+
+
+def test_migrate_torn_import_leaves_pool_unchanged():
+    src = _paged(_transformer())
+    dst = _paged(_transformer())
+    prompt = _prompts([20])[0]
+    try:
+        ref = src.generate(prompt, max_new_tokens=4)
+        payload = src.kv_export(prompt)
+        torn = _retamper(payload)
+        data = torn["leaves"][0]["data"]
+        torn["leaves"][0]["data"] = data[:len(data) // 2]   # cut mid-body
+        before = _pool_snapshot(dst)
+        with pytest.raises(KVMigrateError) as ei:
+            dst.kv_import(torn)
+        assert ei.value.reason == "torn"
+        assert _pool_snapshot(dst) == before     # nothing allocated/indexed
+        # flipped payload bytes (b64 still decodes, checksum breaks)
+        flipped = _retamper(payload)
+        d = flipped["leaves"][0]["data"]
+        flipped["leaves"][0]["data"] = d[:-8] + ("AAAAAAA=" if d[-8:]
+                                                 != "AAAAAAA=" else "BBBBBBA=")
+        with pytest.raises(KVMigrateError) as ei:
+            dst.kv_import(flipped)
+        assert ei.value.reason == "torn"
+        assert _pool_snapshot(dst) == before
+        # the destination is unharmed: a cold generate still matches
+        assert dst.generate(prompt, max_new_tokens=4)["tokens"] \
+            == ref["tokens"]
+    finally:
+        src.stop()
+        dst.stop()
+
+
+# ------------------------------------------------------------- host tier
+
+def test_host_tier_spill_restore_bitwise():
+    prompts = _prompts((40, 40, 40, 40), seed=3)
+
+    def run(host_kv_bytes):
+        eng = _paged(_transformer(), kv_blocks=9,
+                     host_kv_bytes=host_kv_bytes)
+        try:
+            outs = []
+            for _ in range(2):                   # pass 2 re-hits pass 1's
+                for p in prompts:                # evicted (spilled) chains
+                    outs.append(eng.generate(p, max_new_tokens=4)["tokens"])
+            st = eng.stats()
+            info = eng.kv_pool_info()
+            assert eng.trace_count == 1          # ONE step program, still
+            assert st["kv"]["kv_programs"] <= 2
+            return outs, st, info
+        finally:
+            eng.stop()
+
+    base, _, _ = run(None)
+    tiered, st, info = run(32 << 20)
+    assert tiered == base                        # restores are bitwise
+    tier = info["host_tier"]
+    assert tier["spills"] > 0 and tier["blocks"] > 0
+    assert st["kv"]["host_restores"] > 0
+    assert st["kv"]["prefix_hits"] > 0           # the second pass hit
+    assert info["blocks_in_use"] == 0            # no leak
+
+
+def test_host_tier_budget_lru_and_idempotent_put():
+    rows = {"k": np.zeros(25, dtype=np.float32)}     # 100 bytes/entry
+    tier = HostKVTier(byte_budget=300, engine="t")
+    for h in ("h1", "h2", "h3"):
+        tier.put(h, "p", (1,), {"k": rows["k"].copy()})
+    assert len(tier) == 3 and tier.bytes_used == 300
+    tier.put("h1", "p", (1,), {"k": rows["k"].copy()})   # re-spill:
+    assert len(tier) == 3 and tier.stats()["spills"] == 3   # refresh only
+    tier.get("h2")                               # LRU-touch; entry stays
+    assert tier.has("h2")
+    tier.put("h4", "p", (1,), {"k": rows["k"].copy()})
+    assert not tier.has("h3")                    # h3 became LRU and dropped
+    assert tier.has("h1") and tier.has("h2")
+    assert tier.stats()["drops"] == 1
+    # an entry bigger than the whole budget is refused outright
+    tier.put("huge", "p", (1,), {"k": np.zeros(200, dtype=np.float32)})
+    assert not tier.has("huge")
+    n = tier.purge()
+    assert n == 3 and len(tier) == 0 and tier.bytes_used == 0
+
+
+def test_swap_purges_host_tier_and_chain_heads():
+    eng = _paged(_transformer(), kv_blocks=9, host_kv_bytes=32 << 20)
+    try:
+        for p in _prompts((40, 40, 40), seed=5):
+            eng.generate(p, max_new_tokens=2)
+        assert eng.stats()["kv"]["chain_heads"]  # affinity signal is live
+        assert len(eng._host_tier) > 0
+        net2 = _transformer(seed=11)
+        eng.swap_weights(net2.params, net2.state)
+        # stale-affinity regression: the swap must clear BOTH halves of
+        # the routing signal — the advertised digest and the host tier
+        # (stale KV restored under new weights would be silently wrong)
+        assert eng.stats()["kv"]["chain_heads"] == []
+        assert len(eng._host_tier) == 0
+        assert eng._host_tier.stats()["bytes"] == 0
+        out = eng.generate(_prompts((20,), seed=6)[0], max_new_tokens=2)
+        assert len(out["tokens"]) == 2           # serving continues
+        assert eng.stats()["kv"]["chain_heads"]  # and repopulates
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------ pool observability
+
+def test_pool_exhausted_detail_and_high_water():
+    p = BlockPool(6, 8)                          # 5 usable
+    a = p.alloc(3)
+    assert p.high_water == 3
+    b = p.alloc(1)
+    assert p.high_water == 4
+    p.mark_cached(b[0])
+    p.decref(b[0])                               # evictable, not in use
+    with pytest.raises(PoolExhaustedError) as ei:
+        p.alloc(4)
+    e = ei.value
+    assert (e.need, e.free, e.in_use, e.cached) == (4, 2, 3, 1)
+    for x in a:
+        p.decref(x)
+    assert p.high_water == 4                     # sticky across release

@@ -21,7 +21,12 @@ The load-bearing claims pinned here:
 - (slow) the chaos soak: 3 subprocess replicas under a mixed
   /predict+/generate storm, one SIGKILLed and one rolling-restarted
   mid-storm — zero failed in-deadline requests, ejection + failover +
-  re-admission all observed via /metrics.
+  re-admission all observed via /metrics;
+- prefix-affinity routing sends shared-prefix /generate traffic to the
+  replica advertising the prompt's KV chain heads, NEVER overrides the
+  health state machine, forgets a replica's digest after a weight-swap
+  cache clear, and role-aware placement steers fresh prefills away from
+  decode-dedicated replicas (docs/SERVING_TIER.md "Disaggregation").
 """
 
 import json
@@ -428,3 +433,88 @@ def test_chaos_soak_kill_and_roll_replicas_mid_storm(tmp_path):
         router.stop()
         for r in reps:
             r.stop()
+
+
+# --------------------------------------------------------- prefix affinity
+
+def _mk_kv_tier(roles=("mixed", "mixed"), **router_kw):
+    """Paged tinyattn fleet: the replica kind whose decode state the
+    prefix cache / migration / affinity machinery can actually share."""
+    kw = dict(chaos=False, kv="paged", kv_block_size=8, kv_blocks=32,
+              prefix_cache=True, chunk_tokens=8, max_len=64, slots=2)
+    reps = [InProcessReplica(model="tinyattn", role=r, **kw).start()
+            for r in roles]
+    router_kw.setdefault("probe_interval", None)
+    router_kw.setdefault("hedge", False)
+    router = Router([r.url for r in reps], port=0, **router_kw).start()
+    cli = InferenceClient(f"http://127.0.0.1:{router.port}")
+    return reps, router, cli
+
+
+def test_prefix_affinity_routes_to_chain_holder_never_over_health():
+    from deeplearning4j_tpu.serving.router import ReplicaState
+    reps, router, cli = _mk_kv_tier()
+    a, b = reps
+    try:
+        rng = np.random.default_rng(3)
+        prompt = [int(t) for t in rng.integers(0, 16, size=20)]
+        ca = InferenceClient(a.url)
+        try:
+            ref = ca.generate(prompt, max_new_tokens=4)
+        finally:
+            ca.close()
+        router.refresh_affinity()
+        assert router.replicas[a.url].kv_block_size == 8
+        assert len(router.replicas[a.url].chain_heads) == 2
+        # the shared-prefix request lands on the chain holder: its prefix
+        # cache takes the hit and the router counts an affinity hit
+        out = cli.generate(prompt, max_new_tokens=4)
+        assert out["tokens"] == ref["tokens"]
+        assert a.srv.decode_engine.stats()["kv"]["prefix_hits"] >= 1
+        assert _counter_value("dl4jtpu_router_affinity_requests_total",
+                              router=router.id, outcome="hit") >= 1
+        # affinity NEVER overrides health: with the chain holder ejected
+        # the same prompt serves (cold) from the other replica
+        router.replicas[a.url].state = ReplicaState.EJECTED
+        out2 = cli.generate(prompt, max_new_tokens=4)
+        assert out2["tokens"] == ref["tokens"]
+        assert b.srv.decode_engine.stats()["kv"]["prefill_tokens"] > 0
+        router.replicas[a.url].state = ReplicaState.HEALTHY
+        # swap-then-affinity regression: a weight-swap cache clear must
+        # erase the advertised digest at the next refresh — a router
+        # still steering by the stale digest would fan stale-KV risk
+        # fleet-wide
+        a.srv.decode_engine._prefix.clear()
+        router.refresh_affinity()
+        assert len(router.replicas[a.url].chain_heads) == 0
+        hint = router._affinity_hint(
+            "/generate", json.dumps({"tokens": prompt}).encode())
+        assert not hint or a.url not in hint
+    finally:
+        _teardown(reps, router, cli)
+
+
+def test_role_preference_steers_fresh_prefill():
+    reps, router, cli = _mk_kv_tier(roles=("decode", "prefill"))
+    dec_rep, pre_rep = reps
+    try:
+        router.refresh_affinity()
+        assert router.replicas[pre_rep.url].role == "prefill"
+        rng = np.random.default_rng(9)
+        # fresh prompts (no chain anywhere): every primary pick should
+        # prefer the prefill-role replica over the decode-dedicated one
+        for _ in range(3):
+            prompt = [int(t) for t in rng.integers(0, 16, size=20)]
+            cli.generate(prompt, max_new_tokens=2)
+        pre = pre_rep.srv.decode_engine.stats()["kv"]["prefill_tokens"]
+        dec = dec_rep.srv.decode_engine.stats()["kv"]["prefill_tokens"]
+        assert pre > 0 and dec == 0, (pre, dec)
+        # ...but a decode-role replica is still a full server: with the
+        # prefill replica gone it takes the work (preference, not policy)
+        router.replicas[pre_rep.url].admin_down = True
+        prompt = [int(t) for t in rng.integers(0, 16, size=20)]
+        out = cli.generate(prompt, max_new_tokens=2)
+        assert len(out["tokens"]) == 2
+        assert dec_rep.srv.decode_engine.stats()["kv"]["prefill_tokens"] > 0
+    finally:
+        _teardown(reps, router, cli)
