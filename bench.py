@@ -1802,6 +1802,234 @@ def bench_spec_decode(fast=False):
          "fast_variant": fast})
 
 
+def bench_spec_tree(fast=False):
+    """Tree-speculation row: the SAME distilled draft drives a linear
+    k-token chain and a caterpillar token tree of equal depth
+    (docs/DECODING.md "Tree speculation & self-drafting"), and the row
+    measures what the side branches buy. The draft is distilled only to
+    MEDIUM agreement — where a linear chain stalls on near-misses the
+    oracle's runner-up token covers, which is exactly the regime
+    branching pays in.
+
+    Asserted: every speculative output (linear AND tree) token-for-token
+    the plain engine's, ONE step + ONE verify + ONE draft program per
+    engine, tree acceptance-per-tick (mean accepted depth) ≥ the linear
+    chain's; (full mode only) tree tokens/sec ≥ 1.3x linear tokens/sec.
+    ``fast=True`` is the tier-1 CI variant (tests/test_bench_rows.py):
+    tiny widths, the wall-clock ratio reported but not asserted."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import DecodeEngine
+    from deeplearning4j_tpu.serving.spec import SpecConfig
+
+    if fast:
+        vocab, width, dwidth = 13, 24, 8
+        streams, gen_tokens, max_len = 2, 10, 48
+        n_prompts = 2
+    else:
+        vocab, width, dwidth = 77, 256, 48
+        streams, gen_tokens, max_len = 16, 96, 128
+        n_prompts = 4
+    plen, kvec = 8, (3, 2, 2)
+    linear = (1,) * len(kvec)                 # equal-depth chain
+
+    def lstm_lm(n_layers, w, seed):
+        b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+             .weight_init("xavier").list())
+        for _ in range(n_layers):
+            b = b.layer(LSTM(n_out=w, activation="tanh"))
+        return MultiLayerNetwork(
+            b.layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                   loss="mcxent"))
+            .set_input_type(InputType.recurrent(vocab)).build()).init()
+
+    net = lstm_lm(2, width, seed=23)
+    draft = lstm_lm(1, dwidth, seed=5)
+    rs = np.random.RandomState(31)
+    prompts = [[int(t) for t in rs.randint(0, vocab, plen)]
+               for _ in range(n_prompts)]
+
+    base_eng = DecodeEngine(net, slots=streams, max_len=max_len)
+    base_eng.warmup()
+    base_eng.start()
+    try:
+        trajs = [prompts[i] + base_eng.generate(
+                     p, max_new_tokens=gen_tokens, timeout=600)["tokens"]
+                 for i, p in enumerate(prompts)]
+        # distill to MEDIUM agreement only (narrow draft, early stop):
+        # a near-perfect draft never misses, so its tree would have
+        # nothing to hedge — stop as soon as the argmax tracks the
+        # target more often than not
+        eye = np.eye(vocab, dtype=np.float32)
+        x = np.stack([eye[t[:-1]] for t in trajs])
+        y = np.stack([eye[t[1:]] for t in trajs])
+        ds = DataSet(x, y)
+        agree = 0.0
+        for _ in range(40):
+            for _ in range(5):
+                draft.fit(ds)
+            out = np.asarray(draft.output(x))
+            agree = float(np.mean(np.argmax(out, -1) == np.argmax(y, -1)))
+            if agree >= 0.55:
+                break
+
+        meas = (prompts * ((streams + n_prompts - 1) // n_prompts))[:streams]
+
+        def storm(eng):
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new_tokens=gen_tokens) for p in meas]
+            outs = [f.result(timeout=600)["tokens"] for f in futs]
+            return outs, sum(len(o) for o in outs) / (time.perf_counter() - t0)
+
+        base_eng.generate(prompts[0], max_new_tokens=4)   # steady-state
+        base_out, base_tps = storm(base_eng)
+    finally:
+        base_eng.stop()
+
+    res = {}
+    for tag, tree in (("linear", linear), ("tree", kvec)):
+        eng = DecodeEngine(net, slots=streams, max_len=max_len,
+                           spec=SpecConfig(draft, tree=tree))
+        eng.warmup()
+        eng.start()
+        try:
+            eng.generate(prompts[0], max_new_tokens=4)    # steady-state
+            out, tps = storm(eng)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        assert out == base_out, (
+            f"{tag} speculative output diverged from the plain engine")
+        assert st["compiled_programs"] == 1, st
+        assert st["spec"]["verify_programs"] == 1, st
+        assert st["spec"]["draft_programs"] == 1, st
+        res[tag] = (tps, st["spec"])
+    lin_tps, lin_spec = res["linear"]
+    tree_tps, tree_spec = res["tree"]
+    # the tree's whole point: more of the depth budget lands per verify
+    assert (tree_spec["mean_accepted_depth"]
+            >= lin_spec["mean_accepted_depth"]), (tree_spec, lin_spec)
+    speedup = tree_tps / lin_tps
+    if not fast:
+        assert speedup >= 1.3, (
+            f"tree speculation {tree_tps:.1f} tok/s is only "
+            f"{speedup:.2f}x the linear chain's {lin_tps:.1f}")
+    return _emit(
+        f"tree speculation (charRNN 2xLSTM({width}), kvec={list(kvec)} "
+        f"vs linear depth-{len(kvec)}, {streams} streams)", tree_tps,
+        "tokens/sec", BARS["decode"],
+        {"baseline_tokens_per_sec": round(base_tps, 1),
+         "linear_tokens_per_sec": round(lin_tps, 1),
+         "tree_tokens_per_sec": round(tree_tps, 1),
+         "speedup_tree_vs_linear": round(speedup, 2),
+         "tree_nodes": tree_spec["tree_nodes"],
+         "acceptance_rate": {"linear": lin_spec["acceptance_rate"],
+                             "tree": tree_spec["acceptance_rate"]},
+         "mean_accepted_depth": {
+             "linear": round(lin_spec["mean_accepted_depth"], 3),
+             "tree": round(tree_spec["mean_accepted_depth"], 3)},
+         "draft_trace_agreement": round(agree, 3),
+         "outputs_token_identical": True,
+         "fast_variant": fast})
+
+
+def bench_self_draft(fast=False):
+    """Self-drafting row: the target as its OWN int8 draft — zero extra
+    checkpoints (serving/spec/selfdraft.py). The quantized draft agrees
+    with its f32 self almost always, so acceptance sits near the
+    ceiling and the win is dispatch amortization: one k-step draft scan
+    plus one batched verify replaces k+1 sequential target dispatches.
+
+    Asserted: self-drafted output token-for-token the plain engine's,
+    near-ceiling acceptance, ONE step + ONE verify + ONE draft program;
+    (full mode only) self-draft tokens/sec ≥ 1.5x the non-speculative
+    engine. ``fast=True`` is the tier-1 CI variant."""
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import DecodeEngine
+    from deeplearning4j_tpu.serving.spec import SpecConfig
+
+    if fast:
+        vocab, width = 13, 24
+        streams, gen_tokens, max_len = 2, 10, 48
+        n_prompts, accept_floor = 2, 0.6
+    else:
+        vocab, width = 77, 256
+        streams, gen_tokens, max_len = 16, 96, 128
+        n_prompts, accept_floor = 4, 0.8
+    plen, k = 8, 4
+
+    b = (NeuralNetConfiguration.builder().seed(23).updater(Adam(1e-2))
+         .weight_init("xavier").list()
+         .layer(LSTM(n_out=width, activation="tanh"))
+         .layer(LSTM(n_out=width, activation="tanh"))
+         .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                               loss="mcxent"))
+         .set_input_type(InputType.recurrent(vocab)))
+    net = MultiLayerNetwork(b.build()).init()
+    rs = np.random.RandomState(37)
+    prompts = [[int(t) for t in rs.randint(0, vocab, plen)]
+               for _ in range(n_prompts)]
+    meas = (prompts * ((streams + n_prompts - 1) // n_prompts))[:streams]
+
+    def storm(eng):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=gen_tokens) for p in meas]
+        outs = [f.result(timeout=600)["tokens"] for f in futs]
+        return outs, sum(len(o) for o in outs) / (time.perf_counter() - t0)
+
+    base_eng = DecodeEngine(net, slots=streams, max_len=max_len)
+    base_eng.warmup()
+    base_eng.start()
+    try:
+        base_eng.generate(prompts[0], max_new_tokens=4)   # steady-state
+        base_out, base_tps = storm(base_eng)
+    finally:
+        base_eng.stop()
+
+    eng = DecodeEngine(net, slots=streams, max_len=max_len,
+                       spec=SpecConfig(k=k, self_draft="int8"))
+    eng.warmup()
+    eng.start()
+    try:
+        eng.generate(prompts[0], max_new_tokens=4)        # steady-state
+        out, tps = storm(eng)
+        st = eng.stats()
+    finally:
+        eng.stop()
+    assert out == base_out, (
+        "self-drafted output diverged from the plain engine")
+    assert st["compiled_programs"] == 1, st
+    assert st["spec"]["verify_programs"] == 1, st
+    assert st["spec"]["draft_programs"] == 1, st
+    rate = st["spec"]["acceptance_rate"]
+    assert rate >= accept_floor, (
+        f"int8 self-draft acceptance {rate:.3f} below {accept_floor} — "
+        "quantization noise should rarely flip the oracle")
+    speedup = tps / base_tps
+    if not fast:
+        assert speedup >= 1.5, (
+            f"self-drafting {tps:.1f} tok/s is only {speedup:.2f}x the "
+            f"plain engine's {base_tps:.1f}")
+    return _emit(
+        f"self-drafting (charRNN 2xLSTM({width}) as its own int8 draft, "
+        f"k={k}, {streams} streams)", tps, "tokens/sec", BARS["decode"],
+        {"baseline_tokens_per_sec": round(base_tps, 1),
+         "self_draft_tokens_per_sec": round(tps, 1),
+         "speedup_vs_baseline": round(speedup, 2),
+         "acceptance_rate": rate,
+         "mean_accepted_depth": round(st["spec"]["mean_accepted_depth"],
+                                      3),
+         "self_draft": "int8",
+         "outputs_token_identical": True,
+         "fast_variant": fast})
+
+
 def bench_ladder(n_req=384, max_batch=64, fast=False):
     """Measured bucket ladder vs blind pow2 (serving/engine.py autotune).
     The SAME mixed-size non-pow2 traffic runs through two engines: one on
@@ -2904,6 +3132,8 @@ BENCHES = {
     "kv_tier": bench_kv_tier,
     "quantized": bench_quantized,
     "spec_decode": bench_spec_decode,
+    "spec_tree": bench_spec_tree,
+    "self_draft": bench_self_draft,
     "router": bench_router,
     "cold_start": bench_cold_start,
     "autoscale": bench_autoscale,
@@ -2931,7 +3161,7 @@ _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "serving": 120, "ladder": 90, "quantized": 150,
         "decode": 150, "kv_storm": 120, "kv_prefix": 120,
         "kv_affinity": 150, "kv_tier": 120,
-        "spec_decode": 180,
+        "spec_decode": 180, "spec_tree": 180, "self_draft": 120,
         "observability": 160, "robustness": 100,
         "router": 150, "online": 120, "train_perf": 150,
         "cold_start": 120, "autoscale": 150}

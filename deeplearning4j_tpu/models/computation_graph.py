@@ -1105,6 +1105,54 @@ class ComputationGraph:
         out = outs[0] if len(outs) == 1 else outs
         return (out, new_d, stacks) if carry_stack else (out, new_d)
 
+    def tree_chunk(self, params, state, dstate, x, pos0, tree, n,
+                   block_tables=None):
+        """Score a speculation token tree along the topo order (see
+        MultiLayerNetwork.tree_chunk): ``x`` (B, N, F) node activations,
+        vertices apply to the (B, N, F) slices unchanged. Returns
+        ``(y, stacks, kv_windows)`` keyed by layer-node name; ``dstate``
+        is NOT advanced — the verify program rewinds carries from the
+        stacks and commits the accepted path via ``tree_commit``."""
+        if len(self.conf.network_inputs) != 1:
+            raise ValueError(
+                "incremental decode supports single-input graphs; got "
+                f"inputs {self.conf.network_inputs}")
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            cdt = _dtype_of(gc.compute_dtype)
+            x = x.astype(cdt)
+            params = _cast_floats(params, cdt)
+        acts = {self.conf.network_inputs[0]: x}
+        stacks, wins = {}, {}
+        for name in self.conf.topological_order:
+            node = self.conf.nodes[name]
+            if node.kind == "input":
+                continue
+            ins = [acts[i] for i in node.inputs]
+            if node.kind == "vertex":
+                acts[name] = node.vertex.apply(ins)
+                continue
+            st = state.get(name) if state else None
+            y, _, stacks[name], wins[name] = node.layer.tree_chunk(
+                params.get(name, {}), dstate.get(name), ins[0], pos0,
+                tree, n, state=st, block_tables=block_tables)
+            acts[name] = y
+        outs = [acts[n] for n in self.conf.network_outputs]
+        return (outs[0] if len(outs) == 1 else outs), stacks, wins
+
+    def tree_commit(self, dstate, kv_windows, path, pos0, commit_n,
+                    block_tables=None):
+        """Write the accepted root-path's positional KV into the decode
+        state (Layer.tree_commit); nodes without a KV window pass
+        through untouched."""
+        new_d = dict(dstate)
+        for name, win in kv_windows.items():
+            if win is not None:
+                new_d[name] = self.conf.nodes[name].layer.tree_commit(
+                    None, dstate.get(name), win, path, pos0, commit_n,
+                    block_tables=block_tables)
+        return new_d
+
     def evaluate(self, data):
         """First-output classification eval, dispatched through the
         bucketed engine with the host read pipelined one batch behind the

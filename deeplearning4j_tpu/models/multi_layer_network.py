@@ -1024,6 +1024,44 @@ class MultiLayerNetwork:
                                               block_tables=block_tables)
         return (x, new_d, stacks) if carry_stack else (x, new_d)
 
+    def tree_chunk(self, params, state, dstate, x, pos0, tree, n,
+                   block_tables=None):
+        """Score a speculation token tree through the stack: ``x``
+        (B, N, F) node activations in ``tree`` (TreeSpec) order, node n
+        at stream position ``pos0 + tree.depth[n]`` attending only to
+        its root-path (Layer.tree_chunk). Same compute-dtype handling as
+        ``decode_step``. Returns ``(y, stacks, kv_windows)`` — per-layer
+        node-indexed carry snapshot stacks and uncommitted attention K/V
+        windows; ``dstate`` itself is NOT advanced (the verify program
+        rewinds carries from the stacks and commits the accepted path
+        via ``tree_commit``)."""
+        gc = self.conf.global_conf
+        if gc.compute_dtype:
+            cdt = _dtype_of(gc.compute_dtype)
+            x = x.astype(cdt)
+            params = _cast_floats(params, cdt)
+        stacks = [None] * len(self.layers)
+        wins = [None] * len(self.layers)
+        for i, l in enumerate(self.layers):
+            st = state[i] if state else None
+            x, _, stacks[i], wins[i] = l.tree_chunk(
+                params[i], dstate[i], x, pos0, tree, n, state=st,
+                block_tables=block_tables)
+        return x, stacks, wins
+
+    def tree_commit(self, dstate, kv_windows, path, pos0, commit_n,
+                    block_tables=None):
+        """Write the accepted root-path's positional KV into the decode
+        state (Layer.tree_commit); layers without a KV window pass
+        through untouched."""
+        new_d = list(dstate)
+        for i, l in enumerate(self.layers):
+            if kv_windows[i] is not None:
+                new_d[i] = l.tree_commit(None, dstate[i], kv_windows[i],
+                                         path, pos0, commit_n,
+                                         block_tables=block_tables)
+        return new_d
+
     # ------------------------------------------------------------- evaluate
     def _eval_stream(self, data, eval_fn):
         """Shared bucketed+pipelined evaluation core: dispatch runs one

@@ -338,6 +338,101 @@ class MultiHeadAttention(Layer):
             o = o + params["bo"]
         return (o, nd, None) if carry_stack else (o, nd)
 
+    # ---- tree speculation (serving/spec/tree.py) -------------------------
+    def tree_chunk(self, params, dstate, x, pos0, tree, n, state=None,
+                   block_tables=None):
+        """Ancestry-masked attention over N tree nodes WITHOUT touching
+        the cache. Sibling nodes share stream positions, so scattering
+        the window's KV before acceptance (what ``prefill_chunk`` does
+        for a linear window) would collide; instead each node attends to
+        its EFFECTIVE cache — the real cache with positions
+        ``pos0 .. pos0+depth(n)`` replaced by the node's own root-path
+        K/V (``tree.anc_at_depth`` row n). That cache is element-for-
+        element the cache the plain engine would hold after feeding that
+        path, and the math is ``_finish_step`` itself over B*N rows, so
+        every node's output is bitwise the non-speculative step's output
+        for its prefix — the lossless-acceptance bar. The winning path's
+        rows commit in ``tree_commit``; rejected nodes never existed as
+        far as the cache is concerned."""
+        if dstate is None:
+            return super().tree_chunk(params, dstate, x, pos0, tree, n,
+                                      state=state,
+                                      block_tables=block_tables)
+        B, N, _ = x.shape
+        q, k, v = self._project(params, x)              # (B, N, H, Dh)
+        H, Dh = k.shape[2], k.shape[3]
+        if "pk" in dstate:
+            bs = dstate["pk"].shape[1]
+            C = block_tables.shape[1] * bs
+            kc = dstate["pk"][block_tables].reshape(B, C, H, Dh)
+            vc = dstate["pv"][block_tables].reshape(B, C, H, Dh)
+        else:
+            kc, vc = dstate["k"], dstate["v"]
+            C = kc.shape[1]
+        depth = jnp.asarray(tree.depth, jnp.int32)       # (N,)
+        aad = jnp.asarray(tree.anc_at_depth, jnp.int32)  # (N, D+1)
+        Dp1 = aad.shape[1]
+        coff = jnp.arange(C)[None, :] - pos0[:, None]    # (B, C)
+        # cache position pos0+dd holds the node's depth-dd ancestor
+        on_path = ((coff[:, None, :] >= 0)
+                   & (coff[:, None, :] <= depth[None, :, None]))  # (B,N,C)
+        didx = jnp.broadcast_to(
+            jnp.clip(coff, 0, Dp1 - 1)[:, None, :, None, None],
+            (B, N, C, H, Dh))
+
+        def effective(cache, win):
+            path = win[:, aad]                           # (B, N, D+1, H, Dh)
+            g = jnp.take_along_axis(path, didx, axis=2)  # (B, N, C, H, Dh)
+            return jnp.where(on_path[..., None, None], g,
+                             cache[:, None])
+
+        effk = effective(kc, k)
+        effv = effective(vc, v)
+        posn = pos0[:, None] + depth[None, :]            # (B, N)
+        o = self._finish_step(params,
+                              q.reshape(B * N, 1, H, Dh),
+                              effk.reshape(B * N, C, H, Dh),
+                              effv.reshape(B * N, C, H, Dh),
+                              posn.reshape(B * N))
+        return (o.reshape(B, N, self.n_out), dstate, None,
+                {"k": k, "v": v})
+
+    def tree_commit(self, params, dstate, kv_window, path, pos0, commit_n,
+                    block_tables=None):
+        """Scatter the accepted root-path's K/V into the cache at
+        positions ``pos0 + d`` for ``d < commit_n`` — the only tree
+        writes that ever reach the cache. Paged rows outside the commit
+        mask land in the scratch block (the inert-row discipline of
+        ``prefill_chunk``); dense rows use a gather-old/where update so
+        masked depths rewrite their current value bit-for-bit."""
+        B, Dp1 = path.shape
+        rows = jnp.arange(B)
+        poss = pos0[:, None] + jnp.arange(Dp1)[None, :]  # (B, D+1)
+        valid = jnp.arange(Dp1)[None, :] < commit_n[:, None]
+        nidx = jnp.broadcast_to(path[:, :, None, None],
+                                (B, Dp1) + kv_window["k"].shape[2:])
+        kg = jnp.take_along_axis(kv_window["k"], nidx, axis=1)
+        vg = jnp.take_along_axis(kv_window["v"], nidx, axis=1)
+        if "pk" in dstate:
+            bs = dstate["pk"].shape[1]
+            MB = block_tables.shape[1]
+            bidx = jnp.clip(poss // bs, 0, MB - 1)
+            phys = jnp.where(valid, block_tables[rows[:, None], bidx], 0)
+            off = poss % bs
+            return {"pk": dstate["pk"].at[phys, off].set(kg),
+                    "pv": dstate["pv"].at[phys, off].set(vg)}
+        C = dstate["k"].shape[1]
+        cpos = jnp.clip(poss, 0, C - 1)
+        gidx = jnp.broadcast_to(cpos[:, :, None, None],
+                                (B, Dp1) + kg.shape[2:])
+
+        def upd(cache, new):
+            old = jnp.take_along_axis(cache, gidx, axis=1)
+            val = jnp.where(valid[:, :, None, None], new, old)
+            return cache.at[rows[:, None], cpos].set(val)
+
+        return {"k": upd(dstate["k"], kg), "v": upd(dstate["v"], vg)}
+
 
 @register_layer
 @dataclass
@@ -403,3 +498,11 @@ class PositionalEmbedding(Layer):
         poss = jnp.clip(poss, 0, self.max_len - 1)
         y = x + params["P"][poss]
         return (y, dstate, None) if carry_stack else (y, dstate)
+
+    def tree_chunk(self, params, dstate, x, pos0, tree, n, state=None,
+                   block_tables=None):
+        """Tree node n sits at stream position ``pos0 + depth(n)`` — the
+        stateless default's ``apply`` would add P[0:N] by node index."""
+        poss = pos0[:, None] + jnp.asarray(tree.depth, jnp.int32)[None, :]
+        poss = jnp.clip(poss, 0, self.max_len - 1)
+        return x + params["P"][poss], dstate, None, None

@@ -184,6 +184,73 @@ class Layer:
         d, ys = jax.lax.scan(step, dstate, (xs, jnp.arange(K)))
         return jnp.moveaxis(ys[:, :, 0, :], 0, 1), d
 
+    # ---- tree-speculation protocol (serving/spec/tree.py) ----------------
+    # Tree verification feeds N tree NODES as extra window positions:
+    # node n sits at stream position ``pos0 + tree.depth[n]`` and may only
+    # see its own root-path (ancestry, not linearity). Stateless layers
+    # are position-free and just apply(); carry layers scan the nodes with
+    # a node-indexed snapshot stack so every node resumes its PARENT's
+    # carry; attention overrides with an ancestry-masked cache read that
+    # writes NOTHING (siblings share stream positions, so committing
+    # before acceptance would collide) — the winning path's KV lands in
+    # ``tree_commit`` afterwards, inside the same verify program.
+    def tree_chunk(self, params, dstate, x, pos0, tree, n, state=None,
+                   block_tables=None):
+        """Score all N tree nodes in one call. ``x``: (B, N, F) node
+        activations in tree order; ``pos0``: (B,) root stream position;
+        ``tree``: the static ``serving.spec.tree.TreeSpec``; ``n``: (B,)
+        emit budget (0 = inert row, its state must stay bitwise).
+
+        Returns ``(y, new_dstate, carry_stack, kv_window)``:
+
+        - ``y`` (B, N, F_out) per-node outputs,
+        - ``new_dstate`` — positional leaves unchanged (nothing is
+          committed here), carry leaves unchanged (the verifier selects
+          the final carry out of the stack),
+        - ``carry_stack`` — carries stacked along a leading NODE axis
+          (N, B, ...): entry n is the carry after node n's root-path,
+          so rewind is ``stack[path_node, rows]`` (None when the layer
+          keeps no carry),
+        - ``kv_window`` — the N nodes' fresh K/V rows for
+          ``tree_commit`` (attention only, else None)."""
+        if dstate is None:
+            y, _ = self.apply(params, x, state, train=False, rng=None)
+            return y, dstate, None, None
+        B, N = x.shape[0], x.shape[1]
+        xs = jnp.moveaxis(x, 1, 0)[:, :, None, :]       # (N, B, 1, F)
+        parent = jnp.asarray(tree.parent, jnp.int32)
+        depth = jnp.asarray(tree.depth, jnp.int32)
+        tmap = jax.tree_util.tree_map
+        stack0 = tmap(lambda a: jnp.zeros((N,) + a.shape, a.dtype), dstate)
+
+        def step(stack, xt_t):
+            xt, t = xt_t
+            par = parent[t]
+            # resume the PARENT's carry: the root (par < 0) resumes the
+            # slot's incoming carry, every other node its parent snapshot
+            d_in = tmap(
+                lambda s, base: jnp.where(par < 0, base,
+                                          s[jnp.clip(par, 0, N - 1)]),
+                stack, dstate)
+            y, nd = self.decode_step(params, d_in, xt, pos0 + depth[t],
+                                     state=state)
+            stack = tmap(lambda s, a: s.at[t].set(a), stack, nd)
+            return stack, y
+
+        stack, ys = jax.lax.scan(step, stack0, (xs, jnp.arange(N)))
+        return jnp.moveaxis(ys[:, :, 0, :], 0, 1), dstate, stack, None
+
+    def tree_commit(self, params, dstate, kv_window, path, pos0, commit_n,
+                    block_tables=None):
+        """Write the accepted root-path's positional state. ``path``:
+        (B, D+1) accepted node index per depth (saturated past the
+        accepted depth); ``commit_n``: (B,) number of depths to commit
+        (= emitted tokens; 0 = inert row, state bitwise untouched).
+        Only layers with positional state override; the default is a
+        no-op because carry layers roll back through the snapshot stack
+        instead (serving/spec/rewind.py)."""
+        return dstate
+
     def has_params(self) -> bool:
         return True
 

@@ -113,11 +113,14 @@ class DecodeEngine:
     (split prefill into chunks of this many tokens that ride the batched
     iteration cadence next to live decode slots, instead of occupying one
     decode step per prompt token).
-    ``spec``: a ``serving.spec.SpecConfig(draft_model, k)`` switches the
-    scheduler to speculative decoding — a tiny draft proposes k tokens
-    per tick and the target verifies them in one batched step, emitting
-    1..k tokens per tick while staying bitwise-identical to the
-    non-speculative engine (docs/DECODING.md "Speculative decoding").
+    ``spec``: a ``serving.spec.SpecConfig`` switches the scheduler to
+    speculative decoding — a draft (a separate model, or the target
+    itself via ``self_draft``) proposes a token TREE per tick
+    (``tree=(k_1,..,k_D)``; plain ``k`` = the linear chain) and the
+    target verifies every node in one batched step, emitting 1..D+1
+    tokens per tick while staying bitwise-identical to the
+    non-speculative engine (docs/DECODING.md "Tree speculation &
+    self-drafting").
     """
 
     _ids = itertools.count()
@@ -178,19 +181,37 @@ class DecodeEngine:
         self.warmup_seconds: Optional[float] = None
         self._spec = spec
         if spec is not None:
-            # the draft proposes TOKEN IDS the target verifies — only
-            # meaningful over the exact same vocabulary
+            from deeplearning4j_tpu.serving.spec import TreeSpec
+            from deeplearning4j_tpu.serving.spec.selfdraft import \
+                build_self_draft
             if int(spec.k) < 1:
                 raise ValueError(f"spec.k must be >= 1, got {spec.k}")
+            # static tree shape: SpecConfig.tree or the linear (1,)*k
+            self._spec_tree = TreeSpec(spec.kvec())
+            # draft scan width: spine depth + 1 snapshot slack (the extra
+            # position keeps a resume snapshot live at full acceptance)
+            self._spec_k = self._spec_tree.d + 1
             dm = spec.draft_model
-            ditype = (dm.conf.input_types[0]
-                      if hasattr(dm.conf, "network_inputs")
-                      else dm.conf.input_type)
-            if ditype.size != self.vocab:
+            if (dm is None) == (spec.self_draft is None):
                 raise ValueError(
-                    f"draft model vocabulary ({ditype.size}) must match "
-                    f"the target's ({self.vocab})")
-            self._spec_k = int(spec.k)
+                    "spec needs exactly one of draft_model or self_draft "
+                    f"(got draft_model={dm!r}, "
+                    f"self_draft={spec.self_draft!r})")
+            if spec.self_draft is not None:
+                dm, self._spec_draft_precision = build_self_draft(
+                    model, spec)
+            else:
+                # the draft proposes TOKEN IDS the target verifies — only
+                # meaningful over the exact same vocabulary
+                ditype = (dm.conf.input_types[0]
+                          if hasattr(dm.conf, "network_inputs")
+                          else dm.conf.input_type)
+                if ditype.size != self.vocab:
+                    raise ValueError(
+                        f"draft model vocabulary ({ditype.size}) must "
+                        f"match the target's ({self.vocab})")
+                self._spec_draft_precision = spec.draft_precision
+            self._spec_draft_model = dm
 
         from deeplearning4j_tpu import exec as ex
         execu = getattr(model, "_executor", None) or ex.get_executor()
@@ -379,12 +400,13 @@ class DecodeEngine:
         if spec is not None:
             self._verifier = SpecVerifier(
                 self.model, self.id, self.slots, self.max_len,
-                self._spec_k, self.vocab, kv=self.kv,
+                self._spec_tree, self.vocab, kv=self.kv,
                 kv_max_blocks=self.kv_max_blocks)
             self._draft = DraftEngine(
-                spec.draft_model, self.id, self.slots, self.max_len,
+                self._spec_draft_model, self.id, self.slots, self.max_len,
                 self._spec_k, self.vocab,
-                precision=spec.draft_precision)
+                precision=self._spec_draft_precision,
+                side_k=max(self._spec_tree.kvec) - 1)
             self._m_spec_drafted = reg.counter(
                 "dl4jtpu_spec_drafted_tokens_total",
                 "Tokens proposed by the speculative draft model.",
@@ -405,6 +427,22 @@ class DecodeEngine:
                 "against dl4jtpu_decode_token_seconds: speculation wins "
                 "while draft cost + one verify < k target steps).",
                 ("engine",)).labels(**lab)
+            self._m_spec_depth = reg.histogram(
+                "dl4jtpu_spec_accepted_depth",
+                "Accepted tree depth per verify (0 = root correction "
+                "only): the distribution behind the acceptance-rate "
+                "gauge — a mass pile-up at 0 means the tree's depth "
+                "budget is wasted.",
+                ("engine",),
+                buckets=tuple(float(d)
+                              for d in range(self._spec_tree.d + 1))
+            ).labels(**lab)
+            self._m_spec_nodes = reg.gauge(
+                "dl4jtpu_spec_tree_nodes",
+                "Static speculation-tree size (nodes scored per verify "
+                "call) — the verify-cost side of the tree-shape "
+                "trade-off.", ("engine",)).labels(**lab)
+            self._m_spec_nodes.set(float(self._spec_tree.n_nodes))
 
     @property
     def trace_count(self) -> int:
@@ -758,15 +796,15 @@ class DecodeEngine:
             # the draft and verify programs compile here too: an
             # all-inert draft tick and an all-inert verify (n_in == 0
             # everywhere) leave both state trees bitwise intact
-            K = self._spec_k
-            zk = np.zeros((S, K), np.int32)
+            zk = np.zeros((S, self._spec_k), np.int32)
+            zn = np.zeros((S, self._spec_tree.n_nodes), np.int32)
             u, fl = np.zeros(S, np.uint32), np.zeros(S, np.float32)
             self._draft.step(zk, z, z, z, z, f, u, fl, z)
-            vargs = (zk, zk, z, z, f, u, fl, z)
+            vargs = (zn, z, z, f, u, fl, z)
             if self.kv == "paged":
                 vargs = (np.zeros((S, self.kv_max_blocks), np.int32),
                          ) + vargs
-            _, _, _, self._dstate = self._verifier.run(
+            *_, self._dstate = self._verifier.run(
                 params, state, self._dstate, *vargs)
         jax.block_until_ready(self._dstate)
         self.warmup_seconds = time.perf_counter() - t0
@@ -814,7 +852,10 @@ class DecodeEngine:
         if kind == "prefill":
             parts.append(f"c{self.chunk_tokens}")
         if kind in ("draft", "verify"):
-            parts.append(f"k{self._spec_k}")
+            # the tree shape sizes both programs (draft scan width is
+            # d+1, verify window is the node count)
+            parts.append(
+                "t" + ",".join(str(k) for k in self._spec_tree.kvec))
         if kind == "draft":
             from deeplearning4j_tpu.exec import aot as aot_mod
             dp, ds = self._draft._weights()
@@ -854,12 +895,12 @@ class DecodeEngine:
             put("cow", self._cow,
                 (self._dstate, np.zeros(1, np.int32), np.zeros(1, np.int32)))
         if self._draft is not None:
-            K = self._spec_k
-            zk = np.zeros((S, K), np.int32)
+            zk = np.zeros((S, self._spec_k), np.int32)
+            zn = np.zeros((S, self._spec_tree.n_nodes), np.int32)
             dp, ds = self._draft._weights()
             put("draft", self._draft._run,
                 (dp, ds, self._draft._tree, zk, z, z, z, z, f, u, fl, z))
-            vargs = (zk, zk, z, z, f, u, fl, z)
+            vargs = (zn, z, z, f, u, fl, z)
             if self.kv == "paged":
                 vargs = (np.zeros((S, self.kv_max_blocks), np.int32),
                          ) + vargs
@@ -1348,15 +1389,26 @@ class DecodeEngine:
 
         A row is 'ready' once the draft has caught up to the target
         cursor; a fresh slot becomes ready after ceil((plen-1)/k) draft
-        ticks, which overlap the target's own prefill steps."""
+        ticks, which overlap the target's own prefill steps. Catch-up
+        feeds the whole known STREAM (prompt + generated), not just the
+        prompt: a side-branch acceptance leaves the draft's carries
+        behind the emitted stream (its snapshots follow its own spine),
+        and the resync path replays the emitted tokens it missed."""
         S, K = self.slots, self._spec_k
+        tr = self._spec_tree
+
+        def stok(r, p):
+            pl = len(r.prompt)
+            return r.prompt[p] if p < pl else r.generated[p - pl]
+
         catchup, ready, tpre = [], [], []
         for i, r in live:
             plen = len(r.prompt)
+            known = plen + len(r.generated)
             if r.cursor < plen - 1:
                 tpre.append((i, r))
-            if r.draft_cursor < plen - 1:
-                catchup.append((i, r))
+            if r.draft_cursor < known - 1:
+                catchup.append((i, r, known))
             elif r.cursor >= plen - 1 and r.draft_cursor == r.cursor:
                 # the window may not outrun the request budget or the KV
                 # capacity — same write bound as the plain path
@@ -1364,7 +1416,7 @@ class DecodeEngine:
                            self.max_len - r.cursor)
                 if n_in > 0:
                     ready.append((i, r, n_in))
-        dprops = None
+        dprops = dsides = None
         if catchup or ready:
             given = np.zeros((S, K), np.int32)
             n_given = np.zeros(S, np.int32)
@@ -1375,9 +1427,10 @@ class DecodeEngine:
             dseeds = np.zeros(S, np.uint32)
             dtemps = np.zeros(S, np.float32)
             dtopk = np.zeros(S, np.int32)
-            for i, r in catchup:
-                m = min(K, len(r.prompt) - 1 - r.draft_cursor)
-                given[i, :m] = r.prompt[r.draft_cursor:r.draft_cursor + m]
+            for i, r, known in catchup:
+                m = min(K, known - 1 - r.draft_cursor)
+                given[i, :m] = [stok(r, p) for p in
+                                range(r.draft_cursor, r.draft_cursor + m)]
                 n_given[i] = m
                 n_steps[i] = m
                 dpos[i] = r.draft_cursor
@@ -1388,8 +1441,7 @@ class DecodeEngine:
                 r.draft_sel = m - 1
             for i, r, n_in in ready:
                 p = r.cursor
-                given[i, 0] = (r.prompt[p] if p < len(r.prompt)
-                               else r.generated[-1])
+                given[i, 0] = stok(r, p)
                 n_given[i] = 1
                 n_steps[i] = n_in
                 dpos[i] = p
@@ -1401,9 +1453,9 @@ class DecodeEngine:
                 dtopk[i] = r.top_k
             t0 = time.perf_counter()
             with trace.span("spec_draft", rows=len(catchup) + len(ready)):
-                dprops = self._draft.step(given, n_given, n_steps, dpos,
-                                          sel, dreset, dseeds, dtemps,
-                                          dtopk)
+                dprops, dsides = self._draft.step(given, n_given, n_steps,
+                                                  dpos, sel, dreset,
+                                                  dseeds, dtemps, dtopk)
             self._m_spec_draft_seconds.observe(time.perf_counter() - t0)
         if tpre:
             # plain-path prompt consumption rides the ordinary step
@@ -1443,8 +1495,7 @@ class DecodeEngine:
                 r.cursor += 1
         done = []
         if ready:
-            vtok = np.zeros((S, K), np.int32)
-            vdraft = np.zeros((S, K), np.int32)
+            vtok = np.zeros((S, tr.n_nodes), np.int32)
             vpos = np.zeros(S, np.int32)
             vn = np.zeros(S, np.int32)
             vreset = np.zeros(S, bool)
@@ -1452,13 +1503,19 @@ class DecodeEngine:
             vtemps = np.zeros(S, np.float32)
             vtopk = np.zeros(S, np.int32)
             for i, r, n_in in ready:
-                # window fed to the target: the last emitted (or final
-                # prompt) token, then the first n_in-1 proposals — the
-                # proposal at position t is judged against the oracle
-                # computed from the distribution AT t
+                # the slot's token tree: node 0 = the last emitted (or
+                # final prompt) token; each depth-d group = the draft's
+                # own proposal (the spine continuation, child 0) plus
+                # its k_d-1 masked top-logit alternatives — every node
+                # is judged against the oracle computed from the
+                # target's distribution AT that node
                 vtok[i, 0] = given[i, 0]
-                vtok[i, 1:n_in] = dprops[i, :n_in - 1]
-                vdraft[i, :n_in] = dprops[i, :n_in]
+                for dd in range(1, tr.d + 1):
+                    fst, kd = int(tr.first[dd - 1]), tr.kvec[dd - 1]
+                    vtok[i, fst] = dprops[i, dd - 1]
+                    if kd > 1:
+                        vtok[i, fst + 1:fst + kd] = dsides[i, dd - 1,
+                                                           :kd - 1]
                 vpos[i] = r.cursor
                 vn[i] = n_in
                 vreset[i] = r.fresh
@@ -1466,14 +1523,14 @@ class DecodeEngine:
                 vseeds[i] = r.seed & 0xFFFFFFFF
                 vtemps[i] = r.temperature
                 vtopk[i] = r.top_k
-            vargs = (vtok, vdraft, vpos, vn, vreset, vseeds, vtemps, vtopk)
+            vargs = (vtok, vpos, vn, vreset, vseeds, vtemps, vtopk)
             if self._pool is not None:
                 vlive = vn > 0
                 btab = np.where(vlive[:, None], self._tables, 0)
                 vargs = (jnp.asarray(btab.astype(np.int32)),) + vargs
             t0 = time.perf_counter()
             with trace.span("spec_verify", rows=len(ready)):
-                oracle, acc, emit, self._dstate = self._verifier.run(
+                etoks, acc, emit, sacc, self._dstate = self._verifier.run(
                     params, state, self._dstate, *vargs)
             dt = time.perf_counter() - t0
             self._decode_seconds += dt
@@ -1481,11 +1538,16 @@ class DecodeEngine:
             self._m_token_seconds.observe(dt)
             drafted = accepted = 0
             for i, r, n_in in ready:
-                drafted += n_in
+                # judged proposals: tree depths 1..min(d, n_in-1) plus
+                # the budget-capped bonus slot — min(d, n_in) keeps the
+                # rate's ceiling at 1.0 for full spine acceptance
+                drafted += min(tr.d, n_in)
                 accepted += int(acc[i])
+                self._m_spec_depth.observe(float(acc[i]))
+                p0 = r.cursor
                 consumed, finished = 0, False
                 for j in range(int(emit[i])):
-                    tok = int(oracle[i, j])
+                    tok = int(etoks[i, j])
                     r.generated.append(tok)
                     self._m_tokens.inc()
                     consumed += 1
@@ -1495,8 +1557,14 @@ class DecodeEngine:
                         finished = True
                         break
                 r.cursor += consumed
-                r.draft_cursor += consumed
-                r.draft_sel = max(consumed - 1, 0)
+                # draft resync: its carry snapshots follow its OWN spine,
+                # valid through the spine-consistent accepted prefix —
+                # resume from snapshot js (never past the emitted stream);
+                # a side-branch acceptance leaves draft_cursor short and
+                # the catch-up path replays the gap next tick
+                js = max(0, min(consumed - 1, int(sacc[i])))
+                r.draft_cursor = p0 + js + 1
+                r.draft_sel = js
                 if finished:
                     done.append((i, r))
             self._m_spec_drafted.inc(drafted)
@@ -1546,12 +1614,18 @@ class DecodeEngine:
         if self._spec is not None:
             drafted = int(self._m_spec_drafted.value)
             accepted = int(self._m_spec_accepted.value)
-            spec = {"k": self._spec_k,
+            depth = self._m_spec_depth
+            spec = {"k": self._spec_tree.d,
+                    "tree": list(self._spec_tree.kvec),
+                    "tree_nodes": self._spec_tree.n_nodes,
+                    "self_draft": self._spec.self_draft,
                     "draft_precision": self._draft.precision,
                     "drafted_tokens": drafted,
                     "accepted_tokens": accepted,
                     "acceptance_rate": (accepted / drafted if drafted
                                         else 0.0),
+                    "mean_accepted_depth": (depth.sum / depth.count
+                                            if depth.count else 0.0),
                     "verify_programs": self._verifier.programs,
                     "draft_programs": self._draft.programs}
         return {"id": self.id,

@@ -101,6 +101,8 @@ def build_server(model_name: str = "charlstm", port: int = 0,
                  prefix_cache: bool = False,
                  chunk_tokens: Optional[int] = None,
                  spec_draft: Optional[str] = None, spec_k: int = 4,
+                 spec_tree: Optional[str] = None,
+                 spec_self_draft: Optional[str] = None,
                  role: str = "mixed",
                  host_kv_bytes: Optional[int] = None):
     """Assemble (but don't start) a replica InferenceServer. ``charlstm``
@@ -114,9 +116,12 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     (docs/DECODING.md "Paged KV"); ``prefix_cache`` defaults off here
     because the stock charlstm carries recurrent decode state, which the
     prefix cache cannot share. ``spec_draft`` names a draft model (e.g.
-    ``charlstm-draft``) to switch /generate to speculative decoding with
-    ``spec_k`` tokens proposed per tick (docs/DECODING.md "Speculative
-    decoding"); output stays bitwise-identical to the plain engine.
+    ``charlstm-draft``) — or ``spec_self_draft`` reuses the target's own
+    weights (``int8``/``fp8``/``early_exit:M``, no extra checkpoint) —
+    to switch /generate to speculative decoding: ``spec_k`` tokens per
+    tick, or a branching token tree with ``spec_tree`` ("3,2,2" =
+    branching factors per depth); output stays bitwise-identical to the
+    plain engine (docs/DECODING.md "Tree speculation & self-drafting").
     ``tinyattn`` (attention-only decode state) serves /generate with
     full paged-KV features: prefix_cache, /kv/export + /kv/import
     migration, and — with ``host_kv_bytes`` — the host-memory KV tier.
@@ -131,9 +136,15 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     dec = None
     if model_name in ("charlstm", "tinyattn"):
         spec = None
-        if spec_draft is not None:
-            from deeplearning4j_tpu.serving.spec import SpecConfig
-            spec = SpecConfig(build_model(spec_draft), k=spec_k)
+        if spec_draft is not None or spec_self_draft is not None:
+            from deeplearning4j_tpu.serving.spec import (SpecConfig,
+                                                         parse_kvec)
+            spec = SpecConfig(
+                build_model(spec_draft) if spec_draft is not None else None,
+                k=spec_k,
+                tree=(parse_kvec(spec_tree) if spec_tree is not None
+                      else None),
+                self_draft=spec_self_draft)
         dec = DecodeEngine(net, slots=slots, max_len=max_len,
                            max_queue=max_queue, precision=precision,
                            kv=kv, kv_block_size=kv_block_size,
@@ -217,6 +228,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--spec-k", type=int, default=4,
                         help="tokens the draft proposes per tick "
                              "(with --spec-draft)")
+    parser.add_argument("--spec-tree", default=None,
+                        help="tree speculation: branching factors per "
+                             "depth, e.g. '3,2,2' (overrides --spec-k; "
+                             "the draft's trajectory is the spine, "
+                             "top-logit alternatives fill the branches)")
+    parser.add_argument("--spec-self-draft", default=None,
+                        help="self-drafting: the target as its own draft "
+                             "— 'int8' / 'fp8' (quantized) or "
+                             "'early_exit:M' (first M layers + readout); "
+                             "replaces --spec-draft, no extra checkpoint")
     args = parser.parse_args(argv)
 
     # CPU platform before anything touches a backend: replicas are test
@@ -240,6 +261,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                        prefix_cache=args.prefix_cache,
                        chunk_tokens=args.chunk_tokens,
                        spec_draft=args.spec_draft, spec_k=args.spec_k,
+                       spec_tree=args.spec_tree,
+                       spec_self_draft=args.spec_self_draft,
                        role=args.role, host_kv_bytes=args.host_kv_bytes)
     # warmup BEFORE the serve loops start so REPLICA_READY / the port-file
     # handshake mean genuinely ready-to-serve: with --aot this is a
@@ -317,6 +340,8 @@ class ReplicaProcess:
                  kv_blocks: Optional[int] = None, prefix_cache: bool = False,
                  chunk_tokens: Optional[int] = None,
                  spec_draft: Optional[str] = None, spec_k: int = 4,
+                 spec_tree: Optional[str] = None,
+                 spec_self_draft: Optional[str] = None,
                  role: str = "mixed",
                  host_kv_bytes: Optional[int] = None,
                  aot: Optional[str] = None,
@@ -336,6 +361,8 @@ class ReplicaProcess:
         self.chunk_tokens = chunk_tokens
         self.spec_draft = spec_draft
         self.spec_k = spec_k
+        self.spec_tree = spec_tree
+        self.spec_self_draft = spec_self_draft
         self.role = role
         self.host_kv_bytes = host_kv_bytes
         # span tracing in the child (GET /trace serves its ring buffer)
@@ -393,6 +420,10 @@ class ReplicaProcess:
         if self.spec_draft is not None:
             cmd.extend(["--spec-draft", self.spec_draft,
                         "--spec-k", str(self.spec_k)])
+        if self.spec_tree is not None:
+            cmd.extend(["--spec-tree", self.spec_tree])
+        if self.spec_self_draft is not None:
+            cmd.extend(["--spec-self-draft", self.spec_self_draft])
         if self.aot:
             cmd.extend(["--aot", os.fspath(self.aot)])
         env = dict(os.environ)

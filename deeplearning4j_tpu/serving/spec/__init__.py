@@ -1,46 +1,63 @@
-"""Speculative decoding subsystem: draft/verify serving acceleration.
+"""Speculative decoding subsystem: tree draft/verify serving acceleration.
 
 Autoregressive decode is latency-bound, not compute-bound: every token
 costs one full forward of the target model, and the accelerator idles on
 weight bandwidth while the host round-trips. Speculative decoding
 (Leviathan et al. 2023; Chen et al. 2023) breaks the one-token-per-
-forward barrier: a tiny DRAFT model proposes k tokens per tick, the
-target VERIFIES all k in one batched multi-position step (k positions
-through one program costs barely more than one), and an acceptance rule
-keeps the emitted stream exactly the target's own distribution — here
-in its strongest form: bitwise-identical to the non-speculative engine
-for greedy AND seeded temperature sampling, because draft, verify and
-the plain step all share one sampling oracle (accept.py).
+forward barrier: a DRAFT proposes tokens, the target VERIFIES them all
+in one batched multi-position step, and an acceptance rule keeps the
+emitted stream exactly the target's own distribution — here in its
+strongest form: bitwise-identical to the non-speculative engine for
+greedy AND seeded temperature sampling, because draft, verify and the
+plain step all share one sampling oracle (accept.py).
 
-Wiring (``DecodeEngine(spec=SpecConfig(draft_model, k))``):
+Two upgrades over the linear subsystem this grew from (PR 14 shape):
 
-- ``accept.py`` — ``oracle_token`` (the engine sampling rule, also used
-  by the non-speculative step and ``generate_naive``) and
-  ``accept_length`` (leading-match acceptance + correction token).
-- ``draft.py``  — slot-aligned k-step draft scan, one donated compiled
-  program, carry snapshot stacks for rewind, optional int8/fp8 weights.
-- ``verify.py`` — one batched target step over each slot's k-token
-  window through the chunked-prefill write path; rejected positions are
-  causally masked until overwritten, carries roll back via snapshots.
-- ``rewind.py`` — carry-vs-positional state classification and rollback
-  (``Layer.positional_state_keys``).
+- TREE speculation (Medusa / SpecInfer): the draft proposes a static
+  token tree per slot (``tree.py``) — its own trajectory as the spine
+  plus top-logit alternatives as siblings — and ONE verify scores every
+  node under an ancestry mask, so one early mismatch no longer discards
+  the whole tail. A linear draft is the ``(1,) * k`` tree; one code
+  path serves both.
+- SELF-drafting (``selfdraft.py``): the draft reuses the target's own
+  weights (int8/fp8 quantized, or an early-exit truncated stack) —
+  speculation with zero extra checkpoints.
+
+Wiring (``DecodeEngine(spec=SpecConfig(...))``):
+
+- ``accept.py``    — ``oracle_token`` (the engine sampling rule, also
+  used by the non-speculative step and ``generate_naive``) and
+  ``accept_length`` (the linear acceptance rule, kept as the host-side
+  reference the tree walk degenerates to).
+- ``tree.py``      — static tree shapes: flattened node list, parent/
+  depth/ancestor tables, the in-program acceptance walk.
+- ``draft.py``     — slot-aligned draft scan (spine + side proposals),
+  one donated compiled program, carry snapshot stacks for rewind,
+  optional int8/fp8 weights.
+- ``verify.py``    — one batched target step over each slot's node
+  tree; rejected nodes are never written, accepted paths commit inside
+  the same program, carries roll back via node snapshots.
+- ``rewind.py``    — carry-vs-positional state classification and
+  rollback (``Layer.positional_state_keys``).
+- ``selfdraft.py`` — the target as its own draft.
 
 Scheduling stays data-not-shapes: per tick the engine issues at most one
-draft call, one (prefill) step and one verify, each a fixed-(S, k) shape
+draft call, one (prefill) step and one verify, each a fixed-shape
 program compiled exactly once regardless of arrival schedule — the same
 trace-count pins the plain decode path enforces. See docs/DECODING.md
-"Speculative decoding".
+"Tree speculation & self-drafting".
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 from deeplearning4j_tpu.serving.spec.accept import (accept_length,
                                                     oracle_token,
                                                     oracle_tokens)
 from deeplearning4j_tpu.serving.spec.draft import DraftEngine
+from deeplearning4j_tpu.serving.spec.tree import TreeSpec, parse_kvec
 from deeplearning4j_tpu.serving.spec.verify import SpecVerifier
 
 
@@ -50,14 +67,27 @@ class SpecConfig:
 
     ``draft_model``: a model container (MultiLayerNetwork /
     ComputationGraph) implementing the incremental-decode protocol over
-    the SAME vocabulary as the target. ``k``: tokens proposed per tick —
-    tuning table in docs/DECODING.md. ``draft_precision``: quantize the
-    draft weights (``"int8"``/``"fp8"``; None = f32)."""
+    the SAME vocabulary as the target — or None with ``self_draft`` set.
+    ``k``: spine length of the default linear tree (ignored when
+    ``tree`` is given). ``tree``: branching factors per depth, e.g.
+    ``(3, 2, 2)`` — tuning table in docs/DECODING.md. ``self_draft``:
+    ``"int8"`` / ``"fp8"`` (the target as its own quantized draft) or
+    ``"early_exit:M"`` (first M layers + shared readout) — see
+    spec/selfdraft.py. ``draft_precision``: quantize the draft weights
+    (``"int8"``/``"fp8"``; None = f32)."""
 
-    draft_model: Any
+    draft_model: Any = None
     k: int = 4
+    tree: Optional[Tuple[int, ...]] = None
+    self_draft: Optional[str] = None
     draft_precision: Optional[str] = None
 
+    def kvec(self) -> Tuple[int, ...]:
+        """The effective tree shape: ``tree`` or the linear ``(1,)*k``."""
+        if self.tree is not None:
+            return tuple(int(v) for v in self.tree)
+        return (1,) * int(self.k)
 
-__all__ = ["SpecConfig", "DraftEngine", "SpecVerifier", "accept_length",
-           "oracle_token", "oracle_tokens"]
+
+__all__ = ["SpecConfig", "DraftEngine", "SpecVerifier", "TreeSpec",
+           "parse_kvec", "accept_length", "oracle_token", "oracle_tokens"]

@@ -1,5 +1,5 @@
-"""Draft half of the speculative decoder: a tiny model proposing k
-tokens per scheduler tick through ONE compiled, donated program.
+"""Draft half of the speculative decoder: a small model proposing a
+token TREE per scheduler tick through ONE compiled, donated program.
 
 The draft engine is slot-aligned with its owning ``DecodeEngine``: slot i
 of the draft state tree shadows slot i of the target engine, and every
@@ -7,7 +7,7 @@ scheduling decision rides in as (S,)-shaped data (``n_steps`` masks,
 never shapes) so the program compiles exactly once — the same
 trace-count discipline the target step program pins.
 
-One call runs a length-k ``lax.scan`` of the draft model's
+One call runs a length-``k`` ``lax.scan`` of the draft model's
 ``decode_step``: position t consumes ``given[:, t]`` while t < n_given
 (the correction/prompt tokens the host supplies) and the draft's own
 previous proposal after that, and proposes via the SAME sampling oracle
@@ -15,14 +15,18 @@ as the target (serving/spec/accept.py) — under temperature sampling the
 shared ``fold_in(seed, position)`` key couples the draft's categorical
 draw to the target's (Gumbel-max with shared noise), which is what makes
 a good draft's proposals match the target oracle far more often than an
-independent draw would.
+independent draw would. The oracle proposals form the tree's SPINE; when
+the engine runs a branching tree (``side_k > 0``) each position also
+emits its ``side_k`` best alternatives (the spine token's logit masked
+out, so siblings are distinct) — these fill the tree's side branches
+(serving/spec/tree.py), all inside the same scan, same single program.
 
 Rewind: recurrent carries are snapshotted after every scan position into
 (S, k, ...) stacks held INSIDE the donated tree; the next call resumes
-from stack index ``sel`` (host-computed: emitted-1 after a verify, m-1
-after prompt catch-up). Positional leaves (attention KV, always dense
-here) stay in place and are overwritten next tick before the causal mask
-can read them (serving/spec/rewind.py).
+from stack index ``sel`` (host-computed from the verify's spine-
+consistent prefix — see decode.py ``_tick_spec``). Positional leaves
+(attention KV, always dense here) stay in place and are overwritten next
+tick before the causal mask can read them (serving/spec/rewind.py).
 """
 
 from __future__ import annotations
@@ -40,21 +44,28 @@ from deeplearning4j_tpu.serving.spec.rewind import map_state
 
 
 class DraftEngine:
-    """k-token draft proposer for one DecodeEngine (``owner`` = its id).
+    """Tree-draft proposer for one DecodeEngine (``owner`` = its id).
 
-    ``precision`` quantizes the draft weights through the same policy as
-    serving weights (docs/QUANTIZATION.md): int8/fp8 drafts stream from
-    HBM at quantized width — the draft step is tiny and bandwidth-bound,
-    so this is nearly free acceptance-rate-per-second.
+    ``k``: scan positions per call (tree spine depth + 1 — the extra
+    position keeps a snapshot live for the fully-accepted case);
+    ``side_k``: alternatives proposed per position (0 = pure linear
+    drafting). ``precision`` quantizes the draft weights through the
+    same policy as serving weights (docs/QUANTIZATION.md): int8/fp8
+    drafts stream from HBM at quantized width — the draft step is tiny
+    and bandwidth-bound, so this is nearly free acceptance-rate-per-
+    second. With the TARGET model itself as ``model`` this is
+    self-drafting (spec/selfdraft.py): quantization makes the draft
+    cheaper than the target while agreeing with it almost always.
     """
 
     def __init__(self, model, owner, slots, max_len, k, vocab,
-                 precision=None):
+                 precision=None, side_k=0):
         self.model = model
         self.owner = owner
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.k = int(k)
+        self.side_k = int(side_k)
         self.vocab = int(vocab)
         self.programs = 0            # exact XLA trace count (pin: 1)
         self.precision = (resolve_precision(precision)
@@ -72,7 +83,7 @@ class DraftEngine:
         self._run = execu.jit(
             self._impl,
             in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS) + (ex.BATCH,) * 9,
-            out_specs=(ex.BATCH, ex.SLOTS),
+            out_specs=(ex.BATCH, ex.BATCH, ex.SLOTS),
             donate_argnums=(2,))
 
     def _weights(self):
@@ -99,7 +110,8 @@ class DraftEngine:
         snapshot ``sel[i]``, consumes ``given[i, :n_given[i]]`` then its
         own proposals, runs ``n_steps[i]`` scan positions (0 = inert,
         state bit-frozen) at positions ``pos0[i] + t``, and returns the
-        (S, k) proposals plus the re-stacked donated tree."""
+        (S, k) spine proposals, the (S, k, side_k) per-position
+        alternatives, and the re-stacked donated tree."""
         from deeplearning4j_tpu.exec.programs import is_registering
         if not is_registering():
             self.programs += 1
@@ -123,8 +135,8 @@ class DraftEngine:
             tok = jnp.where(t < n_given, given[:, t], prev).astype(jnp.int32)
             x = jax.nn.one_hot(tok, self.vocab, dtype=jnp.float32)[:, None, :]
             y, nd = self.model.decode_step(params, state, d, x, pos0 + t)
-            prop = oracle_tokens(jnp.log(y[:, 0, :]), seeds, pos0 + t,
-                                 temps, topk)
+            logits = jnp.log(y[:, 0, :])
+            prop = oracle_tokens(logits, seeds, pos0 + t, temps, topk)
             live = t < n_steps
 
             def keep(new, old):
@@ -133,16 +145,25 @@ class DraftEngine:
 
             nd = jax.tree_util.tree_map(keep, nd, d)
             prop = jnp.where(live, prop, 0).astype(jnp.int32)
+            if self.side_k > 0:
+                # side branches: best alternatives with the spine token
+                # masked to -inf, so siblings are pairwise distinct and
+                # at most one tree child can ever match the oracle
+                masked = logits.at[rows, prop].set(-jnp.inf)
+                side = jax.lax.top_k(masked, self.side_k)[1]
+                side = jnp.where(live[:, None], side, 0).astype(jnp.int32)
+            else:
+                side = jnp.zeros((S, 0), jnp.int32)
             # snapshot the carries only; positional caches would stack to
             # k full copies — a scalar dummy keeps the pytree constant
             snap = map_state(self.model, nd,
                              on_carry=lambda a: a,
                              on_positional=lambda a: jnp.zeros((), a.dtype))
-            return (nd, prop), (prop, snap)
+            return (nd, prop), (prop, side, snap)
 
         prev0 = jnp.zeros(S, jnp.int32)
-        (d, _), (props, snaps) = jax.lax.scan(body, (d0, prev0),
-                                              jnp.arange(K))
+        (d, _), (props, sides, snaps) = jax.lax.scan(body, (d0, prev0),
+                                                     jnp.arange(K))
         # donated tree out: carries re-stacked from the (K, S, ...) scan
         # snapshots, positional caches from the final scan state
         new_tree = map_state(self.model, snaps,
@@ -158,19 +179,21 @@ class DraftEngine:
         # inert slots stay bit-identical (their stacks are NOT re-stacked
         # with repeated carries — frozen against the pre-scan tree)
         new_tree = jax.tree_util.tree_map(freeze, new_tree, tree0)
-        return jnp.moveaxis(props, 0, 1), new_tree
+        return (jnp.moveaxis(props, 0, 1), jnp.moveaxis(sides, 0, 1),
+                new_tree)
 
     # ---------------------------------------------------------------- host
     def step(self, given, n_given, n_steps, pos0, sel, reset, seeds,
              temps, topk):
-        """Run one draft tick; returns the (S, k) proposals as numpy."""
+        """Run one draft tick; returns the (S, k) spine proposals and the
+        (S, k, side_k) alternatives as numpy."""
         self.ensure_state()
         params, state = self._weights()
         c0, t0 = self.programs, time.perf_counter()
-        props, self._tree = self._run(params, state, self._tree, given,
-                                      n_given, n_steps, pos0, sel, reset,
-                                      seeds, temps, topk)
-        props = np.asarray(props)
+        props, sides, self._tree = self._run(params, state, self._tree,
+                                             given, n_given, n_steps, pos0,
+                                             sel, reset, seeds, temps, topk)
+        props, sides = np.asarray(props), np.asarray(sides)
         if self.programs > c0:
             from deeplearning4j_tpu.exec.programs import get_programs
             get_programs().record(
@@ -178,4 +201,4 @@ class DraftEngine:
                 (params, state, self._tree, given, n_given, n_steps, pos0,
                  sel, reset, seeds, temps, topk),
                 compile_seconds=time.perf_counter() - t0)
-        return props
+        return props, sides

@@ -71,10 +71,13 @@ def map_state(model, dstate, on_carry, on_positional, rest=()):
 
 
 def rewound_state(model, new_d, stacks, idx, rows):
-    """Post-verify state: positional leaves keep the chunk's writes (the
-    causal mask hides rejected positions until they are overwritten);
-    layers that returned a carry snapshot stack are rolled back to
-    snapshot ``idx`` — (K, B, ...) stacks indexed as ``s[idx, rows]`` →
+    """Post-verify state: positional leaves pass through (the causal/
+    ancestry mask hides rejected positions until the accepted path is
+    committed over them); layers that returned a carry snapshot stack
+    are rolled back to snapshot ``idx`` — (K, B, ...) stacks indexed as
+    ``s[idx, rows]``. The index axis is whatever the producer stacked
+    over: chunk positions for a linear prefill window, NODE indices for
+    a tree verify (``Layer.tree_chunk``) — either way ``idx`` selects
     the carry after the last emitted token of each slot."""
     out = dict(new_d) if isinstance(new_d, dict) else list(new_d)
     for key, _layer in layer_entries(model):

@@ -1,0 +1,124 @@
+"""Self-drafting: speculative decoding with ZERO extra checkpoints.
+
+A separate distilled draft model is an ops burden — another artifact to
+train, version, ship and keep vocabulary-aligned. Self-drafting reuses
+the TARGET's own weights as the draft in one of two ways:
+
+- ``self_draft="int8"`` / ``"fp8"`` — the draft IS the target, run at
+  quantized precision through the existing serving-quantization policy
+  (``exec.prepare_params`` → ``dequantize_tree`` inside the draft
+  program, docs/QUANTIZATION.md). The draft streams weights at
+  quantized width and agrees with the f32 target almost always
+  (quantization noise rarely flips the oracle), so acceptance is near 1
+  and the win is dispatch amortization: one k-step draft scan + one
+  batched verify replaces k+1 sequential target dispatches.
+- ``self_draft="early_exit:M"`` — a truncated-stack VIEW of the target:
+  its first M layers plus the shared readout layer, no copied weights
+  (properties alias the target's params), giving a genuinely cheaper
+  draft at lower agreement. Requires a MultiLayerNetwork target whose
+  intermediate width matches the readout's input width (uniform-width
+  stacks — e.g. the charRNN zoo models).
+
+Both forms plug into the unchanged ``DraftEngine`` — the draft model is
+just a model with the incremental-decode protocol — so tree drafting,
+carry snapshots and the one-program pin all apply as-is. Configure via
+``SpecConfig(draft_model=None, self_draft=...)``; replica flag
+``--spec-self-draft`` (serving/replica.py).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.models.multi_layer_network import (MultiLayerNetwork
+                                                           as _MLN)
+
+SELF_DRAFT_QUANT = ("int8", "fp8")
+
+
+def parse_self_draft(mode):
+    """Validate a ``self_draft`` mode string → ``("quant", precision)``
+    or ``("early_exit", M)``."""
+    if mode in SELF_DRAFT_QUANT:
+        return ("quant", mode)
+    if isinstance(mode, str) and mode.startswith("early_exit:"):
+        try:
+            m = int(mode.split(":", 1)[1])
+        except ValueError:
+            m = 0
+        if m < 1:
+            raise ValueError(
+                f"self_draft {mode!r}: early_exit needs a positive layer "
+                "count, e.g. 'early_exit:1'")
+        return ("early_exit", m)
+    raise ValueError(
+        f"self_draft must be one of {SELF_DRAFT_QUANT} or 'early_exit:M', "
+        f"got {mode!r}")
+
+
+class EarlyExitDraft:
+    """Truncated-stack view of a MultiLayerNetwork target: layers
+    ``0..M-1`` plus the final readout, weights ALIASED from the target
+    (``params``/``state`` are properties — a hot swap in the target is a
+    hot swap in the draft). Implements exactly the slice of the model
+    protocol the DraftEngine drives — ``init_decode_state`` and
+    ``decode_step`` are MultiLayerNetwork's own methods over the
+    truncated layer list, so the draft math is the target's math minus
+    the skipped layers."""
+
+    def __init__(self, target, m):
+        if hasattr(target.conf, "network_inputs"):
+            raise ValueError(
+                "early_exit self-drafting needs a MultiLayerNetwork "
+                "target (a graph has no unique layer stack to truncate); "
+                "use self_draft='int8'/'fp8' instead")
+        m = int(m)
+        if not 1 <= m <= len(target.layers) - 1:
+            raise ValueError(
+                f"early_exit:{m} out of range for a "
+                f"{len(target.layers)}-layer target (need 1 <= M <= "
+                f"{len(target.layers) - 1})")
+        readout, last = target.layers[-1], target.layers[m - 1]
+        n_mid = getattr(last, "n_out", None) or getattr(last, "n_in", None)
+        n_ro = getattr(readout, "n_in", None)
+        if n_mid and n_ro and n_mid != n_ro:
+            raise ValueError(
+                f"early_exit:{m}: layer {m - 1} outputs {n_mid} features "
+                f"but the readout expects {n_ro} — early exit needs a "
+                "width-compatible truncation point")
+        self._target = target
+        self.m = m
+        self.conf = target.conf          # global_conf + input_type riders
+        self.layers = list(target.layers[:m]) + [readout]
+        self._executor = getattr(target, "_executor", None)
+
+    @property
+    def params(self):
+        t = self._target.params
+        return [t[i] for i in range(self.m)] + [t[-1]]
+
+    @property
+    def state(self):
+        t = self._target.state
+        if not t:
+            return t
+        return [t[i] for i in range(self.m)] + [t[-1]]
+
+    # the container decode protocol, verbatim over the truncated stack
+    init_decode_state = _MLN.init_decode_state
+    decode_step = _MLN.decode_step
+
+
+def build_self_draft(target, spec):
+    """Resolve ``SpecConfig.self_draft`` → ``(draft_model, precision)``
+    for the DraftEngine (serving/decode.py)."""
+    kind, arg = parse_self_draft(spec.self_draft)
+    if kind == "quant":
+        if spec.draft_precision not in (None, arg):
+            raise ValueError(
+                f"self_draft={spec.self_draft!r} conflicts with "
+                f"draft_precision={spec.draft_precision!r}")
+        return target, arg
+    return EarlyExitDraft(target, arg), spec.draft_precision
+
+
+__all__ = ["EarlyExitDraft", "build_self_draft", "parse_self_draft",
+           "SELF_DRAFT_QUANT"]

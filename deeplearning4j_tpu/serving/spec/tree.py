@@ -1,0 +1,154 @@
+"""Static token-tree shapes for tree speculation (Medusa / SpecInfer).
+
+A linear k-token draft discards its whole tail at the first mismatch.
+A token TREE hedges: at every depth the draft proposes its best guess
+(the SPINE — its own autoregressive trajectory) plus the next-best
+alternatives as siblings, and ONE batched verify scores every node; the
+longest root-path whose tokens match the sampling oracle advances. The
+shape is fixed at trace time so the verify program compiles exactly
+once regardless of acceptance history (the same data-not-shapes
+discipline as the rest of the engine).
+
+The shape here is the *caterpillar* tree ``kvec = (k_1, .., k_D)``: the
+spine node at depth d-1 gets ``k_d`` children — the spine continuation
+(the draft's own sampled token, always child 0 of its depth group) and
+``k_d - 1`` top-logit alternatives with the spine token masked out, so
+siblings are distinct and at most one can match the oracle. Side nodes
+have no children (a side acceptance ends the path but still banks the
+token plus the oracle's bonus). Node count is ``1 + sum(kvec)``; a
+linear draft is exactly ``kvec = (1,) * k``, so one code path serves
+both and the PR-14 linear semantics are the degenerate tree.
+
+Everything static lives in numpy on the host (``parent``/``depth``/
+``anc_at_depth`` index tables baked into the trace); the acceptance
+walk (``walk``) is pure jnp and runs INSIDE the verify program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def parse_kvec(text):
+    """``"3,2,2"`` → ``(3, 2, 2)`` (the replica flag format)."""
+    kvec = tuple(int(p) for p in str(text).split(",") if p.strip())
+    if not kvec:
+        raise ValueError(f"empty tree spec {text!r}")
+    return kvec
+
+
+class TreeSpec:
+    """Immutable flattened token tree for one engine.
+
+    Node 0 is the root (the last emitted token, depth 0). Depth-d nodes
+    occupy the contiguous index range ``first[d-1] .. first[d-1]+k_d-1``
+    with the spine child FIRST; every depth-d node's parent is the
+    depth-(d-1) spine node. Tables (all static numpy, shapes fixed by
+    ``kvec`` alone):
+
+    - ``parent``       (N,)    parent node index, -1 for the root
+    - ``depth``        (N,)    node depth, 0..D
+    - ``spine``        (D+1,)  spine node index per depth
+    - ``first``        (D,)    first node index of each depth group
+    - ``anc_at_depth`` (N, D+1) ancestor-or-self of node n at depth dd
+      (for dd > depth[n] the entry saturates to n — callers mask on
+      ``dd <= depth[n]``). Row n IS node n's root-path, which is how the
+      verify attention builds each node's effective causal cache.
+    """
+
+    def __init__(self, kvec):
+        kvec = tuple(int(k) for k in kvec)
+        if not kvec or any(k < 1 for k in kvec):
+            raise ValueError(
+                f"tree kvec must be positive ints per depth, got {kvec}")
+        self.kvec = kvec
+        self.d = len(kvec)                       # spine length
+        self.n_nodes = 1 + sum(kvec)
+        parent, depth, spine, first = [-1], [0], [0], []
+        nid = 1
+        for dd, k in enumerate(kvec, start=1):
+            first.append(nid)
+            for _ in range(k):
+                parent.append(spine[dd - 1])
+                depth.append(dd)
+            spine.append(nid)                    # spine = first child
+            nid += k
+        self.parent = np.asarray(parent, np.int32)
+        self.depth = np.asarray(depth, np.int32)
+        self.spine = np.asarray(spine, np.int32)
+        self.first = np.asarray(first, np.int32)
+        aad = np.zeros((self.n_nodes, self.d + 1), np.int32)
+        for n in range(self.n_nodes):
+            chain, cur = [], n
+            while cur >= 0:
+                chain.append(cur)
+                cur = int(self.parent[cur])
+            chain = chain[::-1]                  # root .. n
+            aad[n, :len(chain)] = chain
+            aad[n, len(chain):] = n              # saturate past own depth
+        self.anc_at_depth = aad
+
+    def ancestor_matrix(self):
+        """(N, N) bool — ``anc[i, j]`` iff node j is on node i's
+        root-path (ancestor-or-self): the causal tree-mask in matrix
+        form (docs/DECODING.md "Tree speculation")."""
+        N = self.n_nodes
+        anc = np.zeros((N, N), bool)
+        for i in range(N):
+            anc[i, self.anc_at_depth[i, :self.depth[i] + 1]] = True
+        return anc
+
+    # ------------------------------------------------------ acceptance walk
+    def walk(self, node_tokens, oracle, n_in):
+        """Longest accepted root-path, vectorized over slots, traced into
+        the verify program (static loop over depths).
+
+        ``node_tokens``/``oracle``: (S, N) — each node's drafted token
+        and the oracle token sampled from the target's distribution AT
+        that node. ``n_in``: (S,) emit budget (0 = inert row). A depth-d
+        node extends the path iff the path sits at the depth-(d-1) spine
+        node (side nodes are leaves) and the node's token equals the
+        oracle token of the path node above it — the same sample-match
+        rule as linear acceptance, over branches instead of a chain.
+
+        Returns ``(a, emitted, spine_acc, path)``:
+
+        - ``a``        (S,) accepted depth, already capped at n_in - 1
+        - ``emitted``  (S,) tokens to emit = a + 1 (0 for inert rows)
+        - ``spine_acc`` (S,) longest accepted prefix that followed the
+          draft's OWN spine — the draft's carry snapshots are consistent
+          exactly that far (decode.py resyncs the draft past it)
+        - ``path``     (S, D+1) node index of the path at each depth
+          (saturates at the deepest accepted node; entries past ``a``
+          are masked by every consumer)
+        """
+        S = node_tokens.shape[0]
+        cur = jnp.zeros(S, jnp.int32)
+        a = jnp.zeros(S, jnp.int32)
+        ok = jnp.ones(S, bool)
+        on_spine = jnp.ones(S, bool)
+        spine_acc = jnp.zeros(S, jnp.int32)
+        path = [cur]
+        for dd in range(1, self.d + 1):
+            f, kd = int(self.first[dd - 1]), self.kvec[dd - 1]
+            want = jnp.take_along_axis(oracle, cur[:, None], axis=1)[:, 0]
+            toks = node_tokens[:, f:f + kd]              # (S, k_d) static
+            m = toks == want[:, None]
+            hit = (m.any(axis=1) & ok
+                   & (cur == int(self.spine[dd - 1]))
+                   & (dd < n_in))                        # emit budget cap
+            child = (f + jnp.argmax(m, axis=1)).astype(jnp.int32)
+            cur = jnp.where(hit, child, cur)
+            a = a + hit
+            on_spine = on_spine & hit & (child == int(self.spine[dd]))
+            spine_acc = spine_acc + on_spine
+            ok = ok & hit
+            path.append(cur)
+        live = n_in > 0
+        emitted = jnp.where(live, a + 1, 0).astype(jnp.int32)
+        return (a.astype(jnp.int32), emitted, spine_acc.astype(jnp.int32),
+                jnp.stack(path, axis=1))
+
+
+__all__ = ["TreeSpec", "parse_kvec"]

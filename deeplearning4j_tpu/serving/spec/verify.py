@@ -1,26 +1,31 @@
-"""Verify half of the speculative decoder: score all k drafted tokens
-for every active slot in ONE batched target step.
+"""Verify half of the speculative decoder: score a whole drafted token
+TREE for every active slot in ONE batched target step.
 
-The verify program is the paged-KV chunked-prefill write path
-(``model.prefill_chunk``) pointed at generated tokens instead of prompt
-tokens: slot i feeds its k-token window ``[tok0, d_0 .. d_{k-2}]`` at
-positions ``pos0 .. pos0+n_in-1``, the model produces the target
-distribution at every window position in one call, and the sampling
-oracle (serving/spec/accept.py — the SAME function the non-speculative
-step uses) turns each distribution into the token the engine would have
-emitted there. ``accept_length`` then gives the per-slot accepted prefix
-``a`` and emit count ``e = min(a+1, n_in)``; the host appends
-``oracle[:e]``, so the emitted stream is bitwise the non-speculative
-trajectory for greedy AND seeded temperature sampling.
+The verify program feeds each slot's N tree nodes as extra window
+positions through ``model.tree_chunk``: node n sits at stream position
+``pos0 + depth(n)`` and attends to the committed cache plus its own
+root-path only (the causal tree-mask, built from the static ancestor
+tables in serving/spec/tree.py — ancestry replaces linearity). The
+sampling oracle (serving/spec/accept.py — the SAME function the
+non-speculative step uses) turns every node's distribution into the
+token the engine would have emitted there, and the acceptance walk
+(``TreeSpec.walk``) follows oracle matches from the root to the longest
+accepted path ``a``; the host appends the path's ``a + 1`` oracle tokens
+(accepted prefix + the deepest node's bonus/correction), so the emitted
+stream is bitwise the non-speculative trajectory for greedy AND seeded
+temperature sampling. A linear draft is the ``kvec = (1,) * k`` tree —
+one program, one code path.
 
-Rejected positions are never "erased": positional KV written for them is
-left in place and hidden by the causal position mask until the next
-tick's chunk overwrites it (scatter-before-gather inside one program —
-see docs/DECODING.md "Speculative decoding"); recurrent carries roll
-back via the per-position snapshot stacks ``carry_stack=True`` returns
+Rejected nodes are never "erased" — they are never WRITTEN: sibling
+nodes share stream positions, so tree attention reads per-node effective
+caches instead of scattering, and only the accepted path's K/V commits
+(``model.tree_commit``, still inside this one program). Recurrent
+carries roll back via the node-indexed snapshot stacks ``tree_chunk``
+returns: the final carry is the accepted node's snapshot
 (serving/spec/rewind.py). Inert rows (``n_in == 0``) follow the chunked
-prefill discipline exactly: paged writes land in scratch block 0, dense
-rows are write-masked, and a final freeze keeps their state bitwise.
+prefill discipline exactly: paged commits land in scratch block 0, dense
+commits rewrite their current bytes, and a final freeze keeps their
+state bitwise.
 """
 
 from __future__ import annotations
@@ -33,23 +38,25 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.quant import dequantize_tree
 from deeplearning4j_tpu.serving.kv import map_slot_leaves
-from deeplearning4j_tpu.serving.spec.accept import accept_length, oracle_tokens
+from deeplearning4j_tpu.serving.spec.accept import oracle_tokens
 from deeplearning4j_tpu.serving.spec.rewind import rewound_state
 
 
 class SpecVerifier:
     """Owns the single verify program for one DecodeEngine (``owner`` =
-    its id). ``kv``/``kv_max_blocks`` mirror the engine: the paged
-    variant takes the (S, max_blocks) page table as one more data arg,
-    same shape every call."""
+    its id). ``tree``: the engine's static ``TreeSpec`` — every shape in
+    the program is a function of it alone, so the program compiles once
+    regardless of tree acceptance history. ``kv``/``kv_max_blocks``
+    mirror the engine: the paged variant takes the (S, max_blocks) page
+    table as one more data arg, same shape every call."""
 
-    def __init__(self, model, owner, slots, max_len, k, vocab, kv="dense",
-                 kv_max_blocks=0):
+    def __init__(self, model, owner, slots, max_len, tree, vocab,
+                 kv="dense", kv_max_blocks=0):
         self.model = model
         self.owner = owner
         self.slots = int(slots)
         self.max_len = int(max_len)
-        self.k = int(k)
+        self.tree = tree
         self.vocab = int(vocab)
         self.kv = kv
         self.kv_max_blocks = int(kv_max_blocks)
@@ -59,29 +66,31 @@ class SpecVerifier:
         if kv == "paged":
             self._jit = execu.jit(
                 self._impl_paged,
-                in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS) + (ex.BATCH,) * 9,
-                out_specs=(ex.BATCH, ex.BATCH, ex.BATCH, ex.SLOTS),
+                in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS) + (ex.BATCH,) * 8,
+                out_specs=(ex.BATCH,) * 4 + (ex.SLOTS,),
                 donate_argnums=(2,))
         else:
             self._jit = execu.jit(
                 self._impl,
-                in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS) + (ex.BATCH,) * 8,
-                out_specs=(ex.BATCH, ex.BATCH, ex.BATCH, ex.SLOTS),
+                in_specs=(ex.PARAMS, ex.STATE, ex.SLOTS) + (ex.BATCH,) * 7,
+                out_specs=(ex.BATCH,) * 4 + (ex.SLOTS,),
                 donate_argnums=(2,))
 
     # ------------------------------------------------------------- program
-    def _impl(self, params, state, dstate, tokens, draft, pos0, n_in,
-              reset, seeds, temps, topk, btab=None):
-        """ONE verify for all S slots. ``tokens`` (S, k): the window fed
-        to the target (``tok0`` then the first k-1 proposals); ``draft``
-        (S, k): all k proposals to judge; ``n_in`` (S,): valid window
-        length (0 = inert row). Returns ``(oracle, accepted, emitted,
-        new_dstate)`` — oracle masked to the emitted prefix."""
+    def _impl(self, params, state, dstate, tokens, pos0, n_in, reset,
+              seeds, temps, topk, btab=None):
+        """ONE verify for all S slots. ``tokens`` (S, N): each slot's
+        flattened tree node tokens (node 0 = the last emitted token,
+        then depth groups in ``TreeSpec`` order); ``n_in`` (S,): emit
+        budget — at most n_in tokens may advance this tick (0 = inert
+        row). Returns ``(emit, accepted, emitted, spine_acc,
+        new_dstate)`` — ``emit`` (S, D+1) holds the accepted path's
+        oracle tokens masked to the emitted prefix."""
         from deeplearning4j_tpu.exec.programs import is_registering
         if not is_registering():
             self.programs += 1
         params = dequantize_tree(params)
-        S, K = self.slots, self.k
+        S, tr = self.slots, self.tree
         tmap = (jax.tree_util.tree_map if btab is None else map_slot_leaves)
 
         def wipe(a):
@@ -92,19 +101,25 @@ class SpecVerifier:
         # one-token prompt): the reset wipe lives here like in the step
         dstate = tmap(wipe, dstate)
         x = jax.nn.one_hot(tokens, self.vocab, dtype=jnp.float32)
-        y, new_d, stacks = self.model.prefill_chunk(
-            params, state, dstate, x, pos0, n_in, block_tables=btab,
-            carry_stack=True)
-        # the target's own emission at every window position, under the
+        y, stacks, wins = self.model.tree_chunk(
+            params, state, dstate, x, pos0, tr, n_in, block_tables=btab)
+        # the target's own emission at every tree node, under the
         # request's fold_in(seed, position) rule — identical by
-        # construction to what the non-speculative step would sample
+        # construction to what the non-speculative step would sample at
+        # that node's stream position after that node's prefix
         oracle = jnp.stack(
-            [oracle_tokens(jnp.log(y[:, t]), seeds, pos0 + t, temps, topk)
-             for t in range(K)], axis=1)
-        accepted, emitted = accept_length(oracle, draft, n_in)
+            [oracle_tokens(jnp.log(y[:, i]), seeds,
+                           pos0 + int(tr.depth[i]), temps, topk)
+             for i in range(tr.n_nodes)], axis=1)
+        accepted, emitted, spine_acc, path = tr.walk(tokens, oracle, n_in)
         rows = jnp.arange(S)
-        idx = jnp.clip(emitted - 1, 0, K - 1)
-        merged = rewound_state(self.model, new_d, stacks, idx, rows)
+        # carries roll back to the accepted node's snapshot; positional
+        # KV commits only the accepted path (masked rows → scratch/no-op)
+        node_idx = jnp.take_along_axis(path, accepted[:, None],
+                                       axis=1)[:, 0]
+        merged = rewound_state(self.model, dstate, stacks, node_idx, rows)
+        merged = self.model.tree_commit(merged, wins, path, pos0, emitted,
+                                        block_tables=btab)
         live = n_in > 0
 
         def freeze(new, old):
@@ -112,26 +127,27 @@ class SpecVerifier:
             return jnp.where(m, new, old)
 
         merged = tmap(freeze, merged, dstate)
-        oracle = jnp.where(jnp.arange(K)[None, :] < emitted[:, None],
-                           oracle, 0).astype(jnp.int32)
-        return oracle, accepted, emitted, merged
+        emit = jnp.take_along_axis(oracle, path, axis=1)      # (S, D+1)
+        emit = jnp.where(jnp.arange(tr.d + 1)[None, :] < emitted[:, None],
+                         emit, 0).astype(jnp.int32)
+        return emit, accepted, emitted, spine_acc, merged
 
-    def _impl_paged(self, params, state, dstate, btab, tokens, draft,
-                    pos0, n_in, reset, seeds, temps, topk):
+    def _impl_paged(self, params, state, dstate, btab, tokens, pos0, n_in,
+                    reset, seeds, temps, topk):
         """Paged verify: page table right after the donated state (same
         argument discipline as the paged step program)."""
-        return self._impl(params, state, dstate, tokens, draft, pos0,
-                          n_in, reset, seeds, temps, topk, btab=btab)
+        return self._impl(params, state, dstate, tokens, pos0, n_in,
+                          reset, seeds, temps, topk, btab=btab)
 
     # ---------------------------------------------------------------- host
     def run(self, params, state, dstate, *args):
-        """Run one verify; returns (oracle, accepted, emitted) as numpy
-        plus the new donated state tree."""
+        """Run one verify; returns (emit, accepted, emitted, spine_acc)
+        as numpy plus the new donated state tree."""
         c0, t0 = self.programs, time.perf_counter()
-        oracle, accepted, emitted, new_d = self._jit(params, state, dstate,
-                                                     *args)
-        out = (np.asarray(oracle), np.asarray(accepted),
-               np.asarray(emitted))
+        emit, accepted, emitted, spine_acc, new_d = self._jit(
+            params, state, dstate, *args)
+        out = (np.asarray(emit), np.asarray(accepted),
+               np.asarray(emitted), np.asarray(spine_acc))
         if self.programs > c0:
             from deeplearning4j_tpu.exec.programs import get_programs
             get_programs().record(

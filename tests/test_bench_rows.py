@@ -121,6 +121,35 @@ def test_spec_decode_row_fast():
                for k in (2, 4))
 
 
+def test_spec_tree_row_fast():
+    row = bench.bench_spec_tree(fast=True)
+    # the function itself asserts token-identical outputs for BOTH the
+    # linear chain and the caterpillar tree, the compile pins, and that
+    # the tree's mean accepted depth dominates the linear chain's; the
+    # ≥1.3x tokens/sec bar is full-mode-only (see module docstring)
+    assert row["unit"] == "tokens/sec"
+    assert row["outputs_token_identical"] is True
+    assert row["tree_nodes"] == 8                 # 1 + sum((3, 2, 2))
+    assert (row["mean_accepted_depth"]["tree"]
+            >= row["mean_accepted_depth"]["linear"])
+    assert 0 < row["acceptance_rate"]["tree"] <= 1.0
+    assert row["linear_tokens_per_sec"] > 0
+    assert row["speedup_tree_vs_linear"] > 0
+
+
+def test_self_draft_row_fast():
+    row = bench.bench_self_draft(fast=True)
+    # the function itself asserts token-identical self-drafted output,
+    # the compile pins, and the near-ceiling int8 acceptance floor; the
+    # ≥1.5x tokens/sec bar is full-mode-only (see module docstring)
+    assert row["unit"] == "tokens/sec"
+    assert row["outputs_token_identical"] is True
+    assert row["self_draft"] == "int8"
+    assert row["acceptance_rate"] >= 0.6
+    assert row["mean_accepted_depth"] > 0
+    assert row["speedup_vs_baseline"] > 0
+
+
 def test_cold_start_row_fast():
     row = bench.bench_cold_start(fast=True)
     # the function itself asserts bitwise-equal first-request outputs and

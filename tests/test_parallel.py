@@ -158,9 +158,13 @@ def test_uneven_batch_padding_gradient_exact():
                 (i, k, np.abs(a - b).max())
 
 
+@pytest.mark.slow
 def test_resnet50_dp_smoke():
     """The north-star config: ResNet50 (a ComputationGraph) training
-    data-parallel on the 8-device mesh (tiny input/batch)."""
+    data-parallel on the 8-device mesh (tiny input/batch). Slow tier: the
+    50-layer fwd+bwd compile alone takes minutes on a 1-core CI box; the
+    CG-through-ParallelWrapper mechanism stays pinned in tier-1 by
+    test_sync_dp_cg_matches_single_device and the conv TP x DP tests."""
     from deeplearning4j_tpu.zoo.resnet import ResNet50
     net = ResNet50(num_classes=10, input_shape=(32, 32, 3)).init()
     rng = np.random.RandomState(0)

@@ -7,14 +7,23 @@ The load-bearing claims pinned here:
   trace form of rejection sampling: draft, verify and the plain step
   share one oracle) — for the charRNN (recurrent carries → snapshot
   rewind) and the causal transformer (positional KV → causal-mask
-  rewind), over dense and paged KV;
+  rewind), over dense and paged KV, for LINEAR drafts and branching
+  TREES (a linear draft is the (1,)*k tree — one code path);
 - COMPILE PINS: one step, one verify, one draft program per engine
-  regardless of k, arrival schedule, prompt lengths or slot mix;
-- REWIND REGRESSION: a slot whose draft windows are ALL fully rejected
-  emits exactly the oracle's correction tokens and continues bitwise —
-  paged KV, prefix cache on and off (garbage KV written for rejected
-  positions is never read and never published);
-- acceptance rule semantics (leading match + correction token);
+  regardless of k, tree shape, arrival schedule, prompt lengths or
+  slot mix;
+- REWIND REGRESSION: a slot whose draft proposals are ALL rejected
+  (every tree node, every tick) emits exactly the oracle's correction
+  tokens and continues bitwise — paged KV, prefix cache on and off
+  (garbage KV written for rejected positions is never read and never
+  published, including by a SECOND request re-claiming the garbage
+  writer's published prefix blocks);
+- SELF-drafting (spec/selfdraft.py): the target as its own int8 draft
+  and as an early-exit truncated stack, both still lossless;
+- acceptance rule semantics (leading match + correction token) and the
+  tree walk's static tables;
+- the acceptance-rate stat and gauge are 0.0 (not NaN) while nothing
+  has been drafted;
 - ``generate_naive`` and the engine share the sampling oracle at
   temperature > 0, not just under greedy argmax.
 """
@@ -30,7 +39,8 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
 from deeplearning4j_tpu.nn.updaters import Adam
 from deeplearning4j_tpu.serving import DecodeEngine, generate_naive
-from deeplearning4j_tpu.serving.spec import SpecConfig, accept_length
+from deeplearning4j_tpu.serving.spec import (SpecConfig, TreeSpec,
+                                             accept_length, parse_kvec)
 from deeplearning4j_tpu.zoo.simple import TinyTransformer
 
 V = 13
@@ -97,6 +107,65 @@ def test_accept_length_leading_match_plus_correction():
     assert a0.tolist() == [0, 0, 0, 0] and e0.tolist() == [0, 0, 0, 0]
 
 
+# ------------------------------------------------------- tree tables
+
+def test_tree_spec_tables():
+    tr = TreeSpec((3, 2))
+    # node 0 = root; depth-1 group = {1, 2, 3} (spine child 1);
+    # depth-2 group = {4, 5} hanging off node 1 (the spine)
+    assert tr.n_nodes == 6 and tr.d == 2
+    assert tr.parent.tolist() == [-1, 0, 0, 0, 1, 1]
+    assert tr.depth.tolist() == [0, 1, 1, 1, 2, 2]
+    assert tr.spine.tolist() == [0, 1, 4]
+    assert tr.first.tolist() == [1, 4]
+    # row n of anc_at_depth is node n's root-path (side nodes saturate)
+    assert tr.anc_at_depth[5].tolist() == [0, 1, 5]
+    assert tr.anc_at_depth[2].tolist() == [0, 2, 2]
+    anc = tr.ancestor_matrix()
+    assert anc[5].tolist() == [True, True, False, False, False, True]
+    # the linear chain is the degenerate tree
+    lin = TreeSpec((1, 1, 1))
+    assert lin.n_nodes == 4 and lin.spine.tolist() == [0, 1, 2, 3]
+    assert parse_kvec("3,2,2") == (3, 2, 2)
+    with pytest.raises(ValueError):
+        TreeSpec((2, 0))
+    with pytest.raises(ValueError):
+        parse_kvec("")
+
+
+def test_tree_walk_accepts_side_branches():
+    """The walk follows oracle matches across branches: a spine miss
+    that a SIBLING covers still advances (and ends the path — side
+    nodes are leaves), and ``spine_acc`` reports only the prefix that
+    followed the draft's own spine."""
+    tr = TreeSpec((2, 2))             # nodes: 0 | 1 2 | 3 4 (off node 1)
+    #            root  d1: spine,side  d2: spine,side
+    toks = jnp.array([[7, 5, 6, 8, 9],     # spine all the way
+                      [7, 5, 6, 8, 9],     # side hit at depth 1
+                      [7, 5, 6, 8, 9],     # spine d1, side d2
+                      [7, 5, 6, 8, 9]])    # total miss
+    # oracle[n] = what the target emits AFTER node n's path
+    oracle = jnp.array([[5, 8, 0, 1, 2],   # wants 5 then 8: spine+spine
+                        [6, 8, 0, 1, 2],   # wants 6: side node 2, leaf
+                        [5, 9, 0, 1, 2],   # wants 5 then 9: spine+side
+                        [4, 8, 0, 1, 2]])  # wants 4: nothing matches
+    n_in = jnp.array([3, 3, 3, 3])
+    a, emitted, spine_acc, path = tr.walk(toks, oracle, n_in)
+    assert a.tolist() == [2, 1, 2, 0]
+    assert emitted.tolist() == [3, 2, 3, 1]
+    # row 1 accepted via the side branch; row 2's depth-2 hit was a side
+    # node — neither extends the spine-consistent prefix
+    assert spine_acc.tolist() == [2, 0, 1, 0]
+    assert path[0].tolist() == [0, 1, 3]
+    assert path[1].tolist() == [0, 2, 2]      # leaf: path saturates
+    assert path[3].tolist() == [0, 0, 0]
+    # emit budget cap: n_in = 1 accepts nothing beyond the correction
+    a1, e1, _, _ = tr.walk(toks, oracle, jnp.array([1, 1, 1, 1]))
+    assert a1.tolist() == [0, 0, 0, 0] and e1.tolist() == [1, 1, 1, 1]
+    a0, e0, _, _ = tr.walk(toks, oracle, jnp.array([0, 0, 0, 0]))
+    assert e0.tolist() == [0, 0, 0, 0]
+
+
 # ------------------------------------------------- lossless: charRNN
 
 @pytest.mark.parametrize("k", [2, 4])
@@ -134,6 +203,103 @@ def test_spec_matches_plain_transformer(kv_kw):
     finally:
         base.stop()
         spec.stop()
+
+
+# ------------------------------------------------ lossless: token trees
+
+def test_spec_tree_matches_plain_charlstm():
+    """Branching caterpillar tree over recurrent carries: side-branch
+    acceptance forces the draft-resync path (its snapshots follow its
+    own spine), and the stream stays bitwise the plain engine's."""
+    net = _lstm_net()
+    draft = _lstm_net(seed=11, width=8)
+    base = DecodeEngine(net, slots=4, max_len=48).start()
+    spec = DecodeEngine(net, slots=4, max_len=48,
+                        spec=SpecConfig(draft, tree=(3, 2))).start()
+    try:
+        assert _run_cases(spec) == _run_cases(base)
+        _assert_spec_pins(spec)
+        assert spec.stats()["spec"]["tree"] == [3, 2]
+        assert spec.stats()["spec"]["tree_nodes"] == 6
+    finally:
+        base.stop()
+        spec.stop()
+
+
+@pytest.mark.parametrize("kv_kw", [
+    dict(kv="dense"),
+    dict(kv="paged", kv_block_size=16, prefix_cache=True),
+], ids=["dense", "paged-prefix"])
+def test_spec_tree_matches_plain_transformer(kv_kw):
+    net = _transformer()
+    draft = _draft_transformer()
+    base = DecodeEngine(net, slots=4, max_len=64, **kv_kw).start()
+    spec = DecodeEngine(net, slots=4, max_len=64,
+                        spec=SpecConfig(draft, tree=(3, 2, 2)),
+                        **kv_kw).start()
+    try:
+        assert _run_cases(spec) == _run_cases(base)
+        _assert_spec_pins(spec)
+    finally:
+        base.stop()
+        spec.stop()
+
+
+# --------------------------------------------- lossless: self-drafting
+
+@pytest.mark.parametrize("mode", ["int8", "early_exit:1"])
+def test_self_draft_matches_plain_charlstm(mode):
+    """The target as its own draft (no separate checkpoint): quantized
+    self-drafting and the early-exit truncated stack both stay bitwise
+    the plain engine's."""
+    net = _lstm_net()
+    base = DecodeEngine(net, slots=4, max_len=48).start()
+    spec = DecodeEngine(net, slots=4, max_len=48,
+                        spec=SpecConfig(k=3, self_draft=mode)).start()
+    try:
+        assert _run_cases(spec) == _run_cases(base)
+        _assert_spec_pins(spec)
+        assert spec.stats()["spec"]["self_draft"] == mode
+    finally:
+        base.stop()
+        spec.stop()
+
+
+def test_self_draft_int8_acceptance_near_one():
+    """A quantized self-draft almost always agrees with its own f32
+    oracle — the acceptance rate should be near the ceiling, which is
+    the entire dispatch-amortization case for self_draft."""
+    net = _lstm_net()
+    eng = DecodeEngine(net, slots=4, max_len=48,
+                       spec=SpecConfig(k=3, self_draft="int8")).start()
+    try:
+        _run_cases(eng)
+        st = eng.stats()["spec"]
+        assert st["draft_precision"] == "int8"
+        assert st["acceptance_rate"] >= 0.8, st
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------- stats guard
+
+def test_acceptance_rate_zero_before_any_draft():
+    """Regression: with nothing drafted yet (fresh engine — the warmup
+    tick is all-inert) the rate stat and gauge must read 0.0, not NaN."""
+    from deeplearning4j_tpu.monitor.metrics import get_registry
+    net = _lstm_net()
+    eng = DecodeEngine(net, slots=2, max_len=48,
+                       spec=SpecConfig(_lstm_net(seed=11, width=8),
+                                       k=3)).start()
+    try:
+        st = eng.stats()["spec"]
+        assert st["drafted_tokens"] == 0
+        assert st["acceptance_rate"] == 0.0
+        assert st["mean_accepted_depth"] == 0.0
+        rate = eng._m_spec_rate.value
+        assert rate == 0.0 and rate == rate     # not NaN
+    finally:
+        eng.stop()
 
 
 def test_spec_with_chunked_prefill_matches_plain():
@@ -182,15 +348,17 @@ def test_spec_arrival_schedule_invariance():
 
 # --------------------------------------------- full-rejection rewind
 
-@pytest.mark.parametrize("prefix_cache", [False, True],
-                         ids=["no-prefix", "prefix"])
-def test_fully_rejected_windows_rewind_bitwise_paged(prefix_cache):
+@pytest.mark.parametrize("prefix_cache,tree", [
+    (False, None), (True, None), (True, (2, 2)),
+], ids=["no-prefix", "prefix", "prefix-tree"])
+def test_fully_rejected_windows_rewind_bitwise_paged(prefix_cache, tree):
     """Regression for the paged rewind path: an adversarial draft whose
-    proposals NEVER match forces every window to full rejection (emit =
-    correction token only). The stream must still be bitwise the plain
-    engine's, including a SECOND request that (with the prefix cache on)
-    re-claims blocks published by the garbage-writing first stream —
-    proving rejected-position KV is neither read nor published."""
+    proposals NEVER match (every tree node, every tick) forces every
+    verify to full rejection (emit = correction token only). The stream
+    must still be bitwise the plain engine's, including a SECOND request
+    that (with the prefix cache on) re-claims blocks published by the
+    garbage-writing first stream — proving rejected-position KV is
+    neither read nor published, branching trees included."""
     net = _transformer()
     # block_size 4: the 6-token prompt fills one FULL block, so the first
     # stream publishes it and the second can take a prefix hit
@@ -202,19 +370,20 @@ def test_fully_rejected_windows_rewind_bitwise_paged(prefix_cache):
     finally:
         base.stop()
     # a token id the greedy trajectory never emits → never equals the
-    # oracle → every draft window is fully rejected
+    # oracle → every draft proposal is rejected
     unused = sorted(set(range(V)) - set(ref["tokens"]))
     assert unused, "need a token id outside the reference trajectory"
     wrong = unused[0]
 
     spec = DecodeEngine(net, slots=2, max_len=64,
-                        spec=SpecConfig(_draft_transformer(), k=4),
+                        spec=SpecConfig(_draft_transformer(), k=4,
+                                        tree=tree),
                         **kv_kw).start()
     real_step = spec._draft.step
 
     def adversarial_step(*args, **kw):
-        props = real_step(*args, **kw)
-        return np.full_like(props, wrong)
+        props, sides = real_step(*args, **kw)
+        return np.full_like(props, wrong), np.full_like(sides, wrong)
 
     spec._draft.step = adversarial_step
     try:
@@ -225,10 +394,44 @@ def test_fully_rejected_windows_rewind_bitwise_paged(prefix_cache):
         assert st["accepted_tokens"] == 0
         assert st["drafted_tokens"] > 0
         assert st["acceptance_rate"] == 0.0
+        assert st["mean_accepted_depth"] == 0.0
         if prefix_cache:
             assert spec.stats()["kv"]["prefix_hits"] >= 1
     finally:
         spec.stop()
+
+
+# ------------------------------------------------- replica flag plumbing
+
+def test_replica_spec_flags_subprocess(tmp_path):
+    """``--spec-tree`` / ``--spec-self-draft`` ride ReplicaProcess →
+    replica CLI → build_server → SpecConfig: the child boots, /generate
+    is bitwise ``generate_naive`` over the same stock weights (the
+    lossless claim end-to-end through the subprocess boundary), and
+    /stats advertises the tree shape."""
+    from deeplearning4j_tpu.serving import InferenceClient
+    from deeplearning4j_tpu.serving.replica import (ReplicaProcess,
+                                                    build_model)
+    rep = ReplicaProcess(str(tmp_path), model="charlstm", chaos=False,
+                         warmup=False, name="spec-tree",
+                         spec_tree="2,2", spec_self_draft="int8").start()
+    try:
+        rep.wait_ready()
+        cli = InferenceClient(rep.url)
+        prompt = [1, 2, 3]
+        out = cli.generate(prompt, max_new_tokens=10, seed=0)
+        ref = generate_naive(build_model("charlstm"), prompt,
+                             max_new_tokens=10, max_len=64)
+        assert out["tokens"] == ref["tokens"]
+        spec = cli.stats()["decode"]["spec"]
+        assert spec["tree"] == [2, 2]
+        assert spec["self_draft"] == "int8"
+        assert spec["drafted_tokens"] > 0
+        assert spec["verify_programs"] == 1
+        assert spec["draft_programs"] == 1
+        cli.close()
+    finally:
+        rep.stop()
 
 
 # ------------------------------------------------- one sampling oracle
@@ -271,3 +474,32 @@ def test_spec_config_validation():
     with pytest.raises(ValueError, match="vocabulary"):
         DecodeEngine(net, slots=2, max_len=48,
                      spec=SpecConfig(_BadDraft(), k=4))
+
+    # exactly one of draft_model / self_draft
+    with pytest.raises(ValueError, match="exactly one"):
+        DecodeEngine(net, slots=2, max_len=48, spec=SpecConfig(k=4))
+    with pytest.raises(ValueError, match="exactly one"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(_lstm_net(seed=11, width=8), k=4,
+                                     self_draft="int8"))
+    with pytest.raises(ValueError, match="self_draft"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(self_draft="int7"))
+    with pytest.raises(ValueError, match="positive layer count"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(self_draft="early_exit:0"))
+    with pytest.raises(ValueError, match="out of range"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(self_draft="early_exit:9"))
+    with pytest.raises(ValueError, match="conflicts"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(self_draft="int8",
+                                     draft_precision="fp8"))
+    with pytest.raises(ValueError, match="kvec"):
+        DecodeEngine(net, slots=2, max_len=48,
+                     spec=SpecConfig(_lstm_net(seed=11, width=8),
+                                     tree=(2, 0)))
+    # early-exit needs a layer stack, not a graph
+    with pytest.raises(ValueError, match="MultiLayerNetwork"):
+        DecodeEngine(_transformer(), slots=2, max_len=64,
+                     spec=SpecConfig(self_draft="early_exit:1"))

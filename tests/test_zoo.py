@@ -33,11 +33,13 @@ def test_simplecnn():
     _fwd_check(SimpleCNN(num_classes=5, input_shape=(48, 48, 3)), (48, 48, 3), 5)
 
 
+@pytest.mark.slow
 def test_alexnet_small():
     # 224 is the reference default; use it (one forward, batch 2)
     _fwd_check(AlexNet(num_classes=7), (224, 224, 3), 7)
 
 
+@pytest.mark.slow
 def test_vgg16_small_input():
     _fwd_check(VGG16(num_classes=10, input_shape=(32, 32, 3)), (32, 32, 3), 10)
 
@@ -65,6 +67,7 @@ def test_resnet50():
     assert net.num_params() > 23_000_000  # ~23.6M + fc
 
 
+@pytest.mark.slow
 def test_googlenet():
     _fwd_check(GoogLeNet(num_classes=10, input_shape=(64, 64, 3)), (64, 64, 3), 10)
 
@@ -75,6 +78,7 @@ def test_inception_resnet_v1():
                (96, 96, 3), 10)
 
 
+@pytest.mark.slow
 def test_facenet():
     _fwd_check(FaceNetNN4Small2(num_classes=10), (96, 96, 3), 10)
 
