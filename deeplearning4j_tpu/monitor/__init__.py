@@ -22,6 +22,10 @@ Fleet additions (docs/OBSERVABILITY.md):
   health, wired into router and replica ``/healthz``.
 - ``profiling`` — ``POST /admin/profile`` around live traffic and
   ``DL4JTPU_PROFILE=dir`` around ``fit()``.
+- ``reqlog`` — the wide-event request journal: one terminal record per
+  request (phases, outcome, spec/KV accounting), served at
+  ``GET /requests`` and merged fleet-wide by ``collect.collect_requests``
+  (docs/OBSERVABILITY.md "Request lifecycle").
 - ``flight`` — the training flight recorder: per-layer telemetry
   computed inside the jitted train step, a crash-safe ring of recent
   records (``GET /train/diagnostics``), anomaly detection, Perfetto
@@ -41,7 +45,9 @@ from deeplearning4j_tpu.monitor.tracing import (
     TraceContext, set_context, get_context, trace_context)
 from deeplearning4j_tpu.monitor.slo import BurnRateSLO, SLOState
 from deeplearning4j_tpu.monitor.collect import (
-    collect_fleet_trace, merge_docs, flight_counter_events)
+    collect_fleet_trace, collect_requests, merge_docs,
+    flight_counter_events)
+from deeplearning4j_tpu.monitor.reqlog import RequestLog, new_record
 from deeplearning4j_tpu.monitor.flight import (
     FlightRecorder, AnomalyDetector, STAT_COLS)
 from deeplearning4j_tpu.monitor.profiling import (
@@ -54,7 +60,8 @@ __all__ = [
     "Tracer", "trace", "get_tracer",
     "TraceContext", "set_context", "get_context", "trace_context",
     "BurnRateSLO", "SLOState",
-    "collect_fleet_trace", "merge_docs", "flight_counter_events",
+    "collect_fleet_trace", "collect_requests", "merge_docs",
+    "flight_counter_events", "RequestLog", "new_record",
     "FlightRecorder", "AnomalyDetector", "STAT_COLS",
     "start_profile", "profile_status", "profile_scope",
 ]

@@ -23,8 +23,8 @@ import json
 import urllib.request
 from typing import Iterable, Optional
 
-__all__ = ["fetch_json", "collect_fleet_trace", "merge_docs",
-           "flight_counter_events"]
+__all__ = ["fetch_json", "collect_fleet_trace", "collect_requests",
+           "merge_docs", "flight_counter_events"]
 
 
 def fetch_json(url: str, timeout: float = 10.0) -> dict:
@@ -120,6 +120,71 @@ def collect_fleet_trace(router_url: str,
             pass
     doc = merge_docs(docs, rebase=rebase)
     doc["collectedFrom"] = pulled
+    if path:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+def _base_rid(rid) -> str:
+    """Router attempt ids are ``rid#aN``; the base rid joins an attempt's
+    replica record back to its router annotation."""
+    return rid.split("#", 1)[0] if isinstance(rid, str) else str(rid)
+
+
+def collect_requests(router_url: str,
+                     extra_urls: Iterable[str] = (),
+                     n: Optional[int] = None,
+                     path: Optional[str] = None,
+                     timeout: float = 10.0) -> dict:
+    """Pull ``GET /requests`` from the router and every replica it routes
+    to, and merge the wide-event journals by request id.
+
+    Same discovery and resilience contract as
+    :func:`collect_fleet_trace`: replicas come from the router's
+    ``/stats``, ``router_url`` may be a plain replica, unreachable fleet
+    members are skipped. The merge joins each router annotation record to
+    the replica records of all its attempts (``rid#aN`` → base ``rid``),
+    producing one entry per request::
+
+        {"collectedFrom": [...], "requests": [
+            {"request_id": rid, "ts": earliest, "router": {...} | None,
+             "attempts": [replica records, journal order]}, ...]}
+    """
+    base = router_url.rstrip("/")
+    urls = [base]
+    try:
+        stats = fetch_json(base + "/stats", timeout=timeout)
+        urls.extend(u.rstrip("/") for u in
+                    sorted(stats.get("replicas", {})))
+    except Exception:
+        pass
+    urls.extend(u.rstrip("/") for u in extra_urls)
+    q = "/requests" if n is None else f"/requests?n={int(n)}"
+    merged: dict = {}
+    pulled = []
+    for u in dict.fromkeys(urls):       # dedupe, keep order
+        try:
+            doc = fetch_json(u + q, timeout=timeout)
+        except Exception:
+            continue
+        pulled.append(u)
+        for rec in doc.get("records", ()):
+            rid = _base_rid(rec.get("request_id"))
+            entry = merged.setdefault(
+                rid, {"request_id": rid, "ts": None,
+                      "router": None, "attempts": []})
+            ts = rec.get("ts")
+            if ts is not None and (entry["ts"] is None
+                                   or ts < entry["ts"]):
+                entry["ts"] = ts
+            if rec.get("source") == "router":
+                entry["router"] = rec
+            else:
+                entry["attempts"].append(rec)
+    requests = sorted(merged.values(),
+                      key=lambda e: (e["ts"] is None, e["ts"] or 0.0))
+    doc = {"collectedFrom": pulled, "requests": requests}
     if path:
         with open(path, "w") as f:
             json.dump(doc, f)

@@ -151,7 +151,7 @@ class Histogram(_Child):
     the exposition; p50/p99 derivable by any Prometheus backend — or
     in-process via ``percentile``, which /stats uses)."""
 
-    __slots__ = ("buckets", "_counts", "_sum", "_count")
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_exemplars")
 
     def __init__(self, reg, labelvalues, buckets):
         super().__init__(reg, labelvalues)
@@ -159,8 +159,12 @@ class Histogram(_Child):
         self._counts = [0] * (len(buckets) + 1)   # +1 for +Inf
         self._sum = 0.0
         self._count = 0
+        # last exemplar per bucket: (request_id, observed_value) — the
+        # wide-event hook that lets "p99 got worse" resolve to a concrete
+        # journal record (docs/OBSERVABILITY.md "Request lifecycle")
+        self._exemplars = [None] * (len(buckets) + 1)
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: Optional[str] = None):
         if not self._reg.enabled:
             return
         i = 0
@@ -172,6 +176,8 @@ class Histogram(_Child):
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), float(v))
 
     @property
     def sum(self) -> float:
@@ -190,6 +196,30 @@ class Histogram(_Child):
             cum += c
             out.append((b, cum))
         return out
+
+    def exemplars(self):
+        """[(upper_bound, request_id, observed_value), ...] for every
+        bucket holding a last exemplar (+Inf bound included)."""
+        with self._lock:
+            ex = list(self._exemplars)
+        out = []
+        for b, e in zip(tuple(self.buckets) + (_INF,), ex):
+            if e is not None:
+                out.append((b, e[0], e[1]))
+        return out
+
+    def exemplar_for(self, v: float):
+        """The last (request_id, observed_value) exemplar of the bucket
+        that a value ``v`` falls into — e.g. ``exemplar_for(p99)`` links
+        the p99 bucket to a journal record. None if that bucket never
+        carried an exemplar."""
+        i = 0
+        for b in self.buckets:
+            if v <= b:
+                break
+            i += 1
+        with self._lock:
+            return self._exemplars[i]
 
     def percentile(self, q: float) -> Optional[float]:
         """Linear-interpolated q-quantile (q in [0,1]) from the buckets;
@@ -271,8 +301,8 @@ class _Family:
     def set_function(self, fn):
         return self._solo().set_function(fn)
 
-    def observe(self, v: float):
-        self._solo().observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None):
+        self._solo().observe(v, exemplar=exemplar)
 
     @property
     def value(self) -> float:
@@ -291,6 +321,12 @@ class _Family:
 
     def percentile(self, q: float):
         return self._solo().percentile(q)
+
+    def exemplars(self):
+        return self._solo().exemplars()
+
+    def exemplar_for(self, v: float):
+        return self._solo().exemplar_for(v)
 
 
 class MetricsRegistry:
@@ -342,8 +378,14 @@ class MetricsRegistry:
             self._families.clear()
 
     # ------------------------------------------------------------- exposition
-    def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4.
+
+        ``exemplars=True`` appends an OpenMetrics-style exemplar
+        (``# {request_id="..."} value``) to every histogram bucket line
+        whose bucket carries one. Off by default: strict 0.0.4 parsers
+        reject the suffix, so the flag is for OpenMetrics scrapers and
+        humans chasing a bucket back to its journal record."""
         lines = []
         for name in sorted(self._families):
             fam = self._families[name]
@@ -355,10 +397,18 @@ class MetricsRegistry:
             for key, child in sorted(children):
                 ls = _label_str(fam.labelnames, key)
                 if fam.kind == "histogram":
+                    ex = (dict((b, (rid, v))
+                               for b, rid, v in child.exemplars())
+                          if exemplars else {})
                     for b, cum in child.cumulative():
                         bl = _label_str(fam.labelnames, key,
                                         extra=(("le", _fnum(b)),))
-                        lines.append(f"{name}_bucket{bl} {cum}")
+                        line = f"{name}_bucket{bl} {cum}"
+                        if b in ex:
+                            rid, v = ex[b]
+                            line += (f' # {{request_id="{_escape_label(rid)}"'
+                                     f"}} {_fnum(v)}")
+                        lines.append(line)
                     lines.append(f"{name}_sum{ls} {_fnum(child.sum)}")
                     lines.append(f"{name}_count{ls} {child.count}")
                 else:

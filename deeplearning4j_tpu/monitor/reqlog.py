@@ -1,0 +1,143 @@
+"""Wide-event request journal (docs/OBSERVABILITY.md "Request lifecycle").
+
+One bounded, thread-safe ring of structured per-request records — the
+"wide event" style of Dapper-lineage request telemetry: instead of a
+request smearing its story across N metrics and M log lines, every
+request appends ONE terminal record carrying its whole lifecycle
+(identity, outcome, phase attribution, token and KV accounting, router
+annotations). A p99 regression then links to a concrete, replayable
+record instead of a histogram bucket.
+
+Writers call :meth:`RequestLog.append` exactly once per request, at the
+terminal outcome — completions AND rejections (shed / deadline /
+queue-full), so the journal never under-counts the requests that hurt.
+The ring is a ``deque(maxlen=capacity)``: appends are O(1), the oldest
+record is dropped first, and the process never grows without bound.
+
+Readers pull ``tail(n)`` (newest last) — served over HTTP as
+``GET /requests?n=`` by both the InferenceServer (decode + predict
+journals merged) and the Router (its annotation journal), and merged
+fleet-wide by ``monitor/collect.py::collect_requests`` /
+``tools/tail_requests.py``.
+
+Records are plain dicts (JSON-ready). :func:`new_record` stamps the
+common identity fields; writers add their per-source extras:
+
+- ``source="decode"``: ``phases`` {queue, prefill, decode, verify},
+  ``tokens_in/out``, ``spec`` {drafted, accepted}, ``kv``
+  {peak_blocks, prefix_hit_depth, host_restores}.
+- ``source="predict"``: ``phases`` {queue, bucket, pad, device,
+  readback}, ``rows``, ``batch``.
+- ``source="router"``: ``attempts``, ``attempt_rids``,
+  ``hedge_winner``, ``affinity_hit``, ``replica``, ``status``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+__all__ = ["RequestLog", "new_record"]
+
+#: terminal outcomes a record may carry (informational — not enforced,
+#: so a new writer can extend the vocabulary without touching this file)
+OUTCOMES = ("ok", "eos", "max_new", "shed", "deadline", "error",
+            "failed_over", "hedge_win")
+
+
+def new_record(request_id: Optional[str], source: str, **fields) -> dict:
+    """A journal record with the common identity fields stamped.
+
+    ``ts`` is wall-clock epoch seconds at terminal time (so records from
+    different processes merge onto one timeline, same anchor discipline
+    as the tracer); everything else is the writer's business.
+    """
+    rec = {"request_id": request_id,
+           "source": source,
+           "ts": time.time(),
+           "trace_id": None,
+           "outcome": None,
+           "tenant": "default",
+           "priority": "normal",
+           "wall_seconds": None}
+    rec.update(fields)
+    return rec
+
+
+class RequestLog:
+    """Bounded, thread-safe ring of terminal request records.
+
+    ``capacity`` bounds memory; when full, the OLDEST record is dropped
+    (``total`` keeps counting, so ``dropped = total - len`` is visible
+    in :meth:`snapshot` — a scraper can tell the journal wrapped).
+    """
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = max(int(capacity), 1)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def append(self, record: dict) -> dict:
+        """Append one terminal record (oldest dropped when full)."""
+        with self._lock:
+            self._total += 1
+            self._ring.append(record)
+        return record
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        """The newest ``n`` records, oldest first (all when ``n`` is
+        None; ``n <= 0`` returns [])."""
+        with self._lock:
+            recs = list(self._ring)
+        if n is None:
+            return recs
+        n = int(n)
+        return recs[-n:] if n > 0 else []
+
+    def find(self, request_id: str) -> Optional[dict]:
+        """Newest record for ``request_id`` (exact match), or None."""
+        with self._lock:
+            recs = list(self._ring)
+        for rec in reversed(recs):
+            if rec.get("request_id") == request_id:
+                return rec
+        return None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def total(self) -> int:
+        """Records ever appended (dropped ones included)."""
+        with self._lock:
+            return self._total
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._total - len(self._ring)
+
+    def clear(self) -> "RequestLog":
+        with self._lock:
+            self._ring.clear()
+            self._total = 0
+        return self
+
+    def snapshot(self, n: Optional[int] = None) -> dict:
+        """JSON-ready document: ring accounting + the newest ``n``
+        records (what ``GET /requests?n=`` serves)."""
+        with self._lock:
+            recs = list(self._ring)
+            total = self._total
+        dropped = total - len(recs)
+        if n is not None:
+            n = int(n)
+            recs = recs[-n:] if n > 0 else []
+        return {"capacity": self.capacity,
+                "total": total,
+                "dropped": dropped,
+                "records": recs}

@@ -25,6 +25,7 @@ Overload protection (docs/FAULT_TOLERANCE.md):
 
 from __future__ import annotations
 
+import inspect
 import itertools
 import queue
 import threading
@@ -37,6 +38,7 @@ import numpy as np
 from deeplearning4j_tpu.monitor import (
     DEFAULT_LATENCY_BUCKETS, get_registry, trace)
 from deeplearning4j_tpu.monitor import tracing
+from deeplearning4j_tpu.monitor.reqlog import RequestLog, new_record
 from deeplearning4j_tpu.resilience.errors import (
     BatcherStoppedError, DeadlineExceededError, ServerOverloadedError)
 
@@ -55,8 +57,18 @@ class MicroBatcher:
 
     def __init__(self, engine, max_batch: int = 256,
                  max_latency_ms: float = 2.0, max_queue: int = 1024,
-                 submit_timeout: float = 30.0):
+                 submit_timeout: float = 30.0, journal_capacity: int = 512):
         self.engine = engine
+        # wide-event journal: one terminal record per request, rejections
+        # included (docs/OBSERVABILITY.md "Request lifecycle")
+        self.journal = RequestLog(journal_capacity)
+        # phase attribution needs the engine to accept predict_host(phases=);
+        # anything else (a bare callable in tests) still serves, unphased
+        try:
+            self._phases_ok = "phases" in inspect.signature(
+                engine.predict_host).parameters
+        except (AttributeError, TypeError, ValueError):
+            self._phases_ok = False
         self.max_batch = int(max_batch)
         self.max_latency_ms = float(max_latency_ms)
         self.max_queue = int(max_queue)
@@ -99,6 +111,11 @@ class MicroBatcher:
             "End-to-end request latency: submit() to future resolution "
             "(queueing + merge wait + device call + readback).",
             ("batcher",), buckets=DEFAULT_LATENCY_BUCKETS).labels(**lab)
+        self._m_queue = reg.histogram(
+            "dl4jtpu_predict_queue_seconds",
+            "Time a /predict request waited in the micro-batch queue: "
+            "submit() to dispatch of its merged device call.",
+            ("batcher",), buckets=DEFAULT_LATENCY_BUCKETS).labels(**lab)
         reg.gauge(
             "dl4jtpu_serving_queue_depth",
             "Requests waiting in the micro-batch queue right now.",
@@ -136,6 +153,7 @@ class MicroBatcher:
                 return
             if not item[1].done():
                 self._m_rej_stopped.inc()
+                self._journal_terminal(item, "error")
                 item[1].set_exception(
                     BatcherStoppedError("micro-batcher stopped"))
 
@@ -145,7 +163,8 @@ class MicroBatcher:
 
     # -------------------------------------------------------------- serving
     def submit(self, x, deadline_ms: Optional[float] = None,
-               block: bool = True) -> Future:
+               block: bool = True, request_id: Optional[str] = None,
+               tenant: str = "default", priority: str = "normal") -> Future:
         """Queue a request batch (n, features...); returns a Future whose
         result is the (n, ...) output slice.
 
@@ -156,14 +175,19 @@ class MicroBatcher:
         submits apply backpressure up to ``submit_timeout`` seconds, then
         raise the same. Raises ``BatcherStoppedError`` once ``stop()`` has
         begun — a post-stop submit fails fast instead of hanging forever.
+        ``request_id``/``tenant``/``priority`` identify the request in the
+        wide-event journal; every exit — served OR rejected — leaves
+        exactly one terminal record there.
         """
         x = np.asarray(x)
         t0 = time.perf_counter()
         expires = None if deadline_ms is None else t0 + deadline_ms / 1000.0
         fut: Future = Future()
         # the submitting thread's trace context rides the queue item so the
-        # worker can stamp the device spans with the request's trace_id
-        item = (x, fut, t0, expires, tracing.get_context())
+        # worker can stamp the device spans with the request's trace_id;
+        # the meta dict carries journal identity to the terminal record
+        meta = {"rid": request_id, "tenant": tenant, "priority": priority}
+        item = (x, fut, t0, expires, tracing.get_context(), meta)
         give_up_at = (None if self.submit_timeout is None
                       else t0 + self.submit_timeout)
         with trace.span("enqueue", rows=int(x.shape[0])):
@@ -171,6 +195,7 @@ class MicroBatcher:
                 with self._state_lock:
                     if self._stopping.is_set():
                         self._m_rej_stopped.inc()
+                        self._journal_terminal(item, "error")
                         raise BatcherStoppedError(
                             "micro-batcher is draining/stopped; "
                             "submit() rejected")
@@ -184,6 +209,7 @@ class MicroBatcher:
                 if not block or (give_up_at is not None
                                  and time.perf_counter() >= give_up_at):
                     self._m_rej_full.inc()
+                    self._journal_terminal(item, "shed")
                     raise ServerOverloadedError(
                         f"serving queue full ({self.max_queue} waiting); "
                         "load shed")
@@ -192,6 +218,22 @@ class MicroBatcher:
     def predict(self, x, deadline_ms: Optional[float] = None):
         """Synchronous convenience: submit + wait."""
         return self.submit(x, deadline_ms=deadline_ms).result()
+
+    # ---------------------------------------------------------- wide events
+    def _journal_terminal(self, item, outcome, now: Optional[float] = None,
+                          **extra) -> None:
+        """Append the ONE terminal wide-event record for a request —
+        called at every exit: served, shed, deadline, stopped, errored."""
+        x, _, t0, _, ctx, meta = item
+        now = time.perf_counter() if now is None else now
+        rec = new_record(
+            meta["rid"], "predict",
+            trace_id=None if ctx is None else ctx.trace_id,
+            outcome=outcome, tenant=meta["tenant"],
+            priority=meta["priority"], batcher=self.id,
+            rows=int(x.shape[0]), wall_seconds=now - t0)
+        rec.update(extra)
+        self.journal.append(rec)
 
     # --------------------------------------------------------------- worker
     def _expired(self, item, now) -> bool:
@@ -202,6 +244,7 @@ class MicroBatcher:
             return False
         if not item[1].done():
             self._m_rej_deadline.inc()
+            self._journal_terminal(item, "deadline", now=now)
             item[1].set_exception(DeadlineExceededError(
                 "request deadline expired before dispatch "
                 f"({(now - item[2]) * 1e3:.1f} ms in queue)"))
@@ -240,21 +283,37 @@ class MicroBatcher:
             if not batch:
                 continue
             total = sum(it[0].shape[0] for it in batch)
+            # queue phase ends here: every rider is about to ride one
+            # merged device call
+            for it in batch:
+                self._m_queue.observe(now - it[2], exemplar=it[5]["rid"])
             try:
                 merged = (batch[0][0] if len(batch) == 1
                           else np.concatenate([b[0] for b in batch]))
+                # phase attribution for the merged call (bucket / pad /
+                # device / readback); the spans are shared — every
+                # co-traveller's record carries the same batch phases
+                ph: Optional[dict] = {} if self._phases_ok else None
                 # the merged device call runs under the first rider's trace
                 # context (one call serves many requests; Perfetto shows the
                 # co-travellers via their own enqueue spans)
                 with tracing.trace_context(batch[0][4]):
-                    out = self.engine.predict_host(merged)
+                    out = (self.engine.predict_host(merged, phases=ph)
+                           if ph is not None
+                           else self.engine.predict_host(merged))
                 if isinstance(out, list):   # multi-output graph: first head
                     out = out[0]
                 ofs = 0
                 done = time.perf_counter()
-                for x, fut, t0, _, _ in batch:
+                for x, fut, t0, _, ctx, meta in batch:
                     fut.set_result(out[ofs:ofs + x.shape[0]])
-                    self._m_latency.observe(done - t0)
+                    self._m_latency.observe(done - t0, exemplar=meta["rid"])
+                    phases = {"queue": now - t0}
+                    if ph:
+                        phases.update(ph)
+                    self._journal_terminal(
+                        (x, fut, t0, None, ctx, meta), "ok",
+                        now=done, phases=phases, batch=len(batch))
                     ofs += x.shape[0]
                 self._m_requests.inc(len(batch))
                 self._m_rows.inc(total)
@@ -262,6 +321,7 @@ class MicroBatcher:
             except Exception as e:  # noqa: BLE001 — answer every caller
                 for item in batch:
                     if not item[1].done():
+                        self._journal_terminal(item, "error")
                         item[1].set_exception(e)
 
     # ---------------------------------------------------------------- stats
@@ -285,6 +345,20 @@ class MicroBatcher:
                 "stopped": int(self._m_rej_stopped.value),
                 "deadline": int(self._m_rej_deadline.value)}
 
+    def _slo_stats(self) -> dict:
+        """SLO summaries + per-bucket exemplars (request ids) so a bad
+        percentile resolves to a concrete journal record."""
+        def block(h):
+            p50, p99 = h.percentile(0.5), h.percentile(0.99)
+            return {"count": int(h.count),
+                    "p50_ms": None if p50 is None else round(p50 * 1e3, 4),
+                    "p99_ms": None if p99 is None else round(p99 * 1e3, 4),
+                    "exemplars": [
+                        ["+Inf" if b == float("inf") else b, rid, v]
+                        for b, rid, v in h.exemplars()]}
+        return {"queue": block(self._m_queue),
+                "latency": block(self._m_latency)}
+
     def stats(self) -> dict:
         calls = self.n_device_calls
         p50 = self._m_latency.percentile(0.5)
@@ -299,5 +373,10 @@ class MicroBatcher:
                 "state": "draining" if self.stopping else "serving",
                 "latency_p50_ms": None if p50 is None else p50 * 1e3,
                 "latency_p99_ms": None if p99 is None else p99 * 1e3,
+                "slo": self._slo_stats(),
+                "journal": {"capacity": self.journal.capacity,
+                            "records": len(self.journal),
+                            "total": self.journal.total,
+                            "dropped": self.journal.dropped},
                 "max_batch": self.max_batch,
                 "max_latency_ms": self.max_latency_ms}

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.monitor import get_registry, trace
+from deeplearning4j_tpu.monitor.reqlog import RequestLog, new_record
+from deeplearning4j_tpu.monitor.tracing import get_context
 from deeplearning4j_tpu.resilience.errors import (
     BatcherStoppedError, ServerOverloadedError)
 from deeplearning4j_tpu.quant import (dequantize_tree, record_weight_bytes,
@@ -65,9 +67,15 @@ class _Request:
 
     __slots__ = ("prompt", "max_new", "seed", "temperature", "top_k",
                  "cursor", "generated", "future", "fresh", "t_start",
-                 "kv_blocks", "draft_cursor", "draft_sel", "draft_fresh")
+                 "kv_blocks", "draft_cursor", "draft_sel", "draft_fresh",
+                 "rid", "tenant", "priority", "trace_id",
+                 "t_admit", "t_prefill0", "t_first", "t_last",
+                 "verify_s", "drafted", "accepted",
+                 "prefix_hit", "host_restores")
 
-    def __init__(self, prompt, max_new, seed, temperature, top_k, future):
+    def __init__(self, prompt, max_new, seed, temperature, top_k, future,
+                 rid=None, tenant="default", priority="normal",
+                 trace_id=None):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.seed = int(seed)
@@ -84,6 +92,23 @@ class _Request:
         self.draft_cursor = 0    # next input position the DRAFT will feed
         self.draft_sel = 0       # snapshot stack index to resume carries at
         self.draft_fresh = True  # first draft call must wipe the draft slot
+        # request-lifecycle identity + host-side perf_counter stamps (the
+        # wide-event record, docs/OBSERVABILITY.md "Request lifecycle").
+        # Every stamp rides an existing host-side point in the tick loop
+        # — the instrumentation adds ZERO device syncs.
+        self.rid = rid
+        self.tenant = tenant
+        self.priority = priority
+        self.trace_id = trace_id
+        self.t_admit = None      # slot claimed (queue phase ends)
+        self.t_prefill0 = None   # first prefill work dispatched
+        self.t_first = None      # first token emitted (TTFT)
+        self.t_last = None       # latest emission run (ITL reference)
+        self.verify_s = 0.0      # spec: wall spent in verify calls
+        self.drafted = 0         # spec: tokens proposed for this stream
+        self.accepted = 0        # spec: tokens accepted for this stream
+        self.prefix_hit = 0      # paged: prompt positions reused from cache
+        self.host_restores = 0   # paged: host-tier blocks promoted for us
 
 
 class DecodeEngine:
@@ -132,7 +157,7 @@ class DecodeEngine:
                  prefix_cache: bool = True,
                  chunk_tokens: Optional[int] = None,
                  host_kv_bytes: Optional[int] = None,
-                 spec=None):
+                 spec=None, journal_capacity: int = 512):
         self.model = model
         self.slots = int(slots)
         self.max_len = int(max_len)
@@ -296,6 +321,27 @@ class DecodeEngine:
             "Per-token latency: wall seconds of one batched step (every "
             "active stream advances one token per step).",
             ("engine",)).labels(**lab)
+        # request-lifecycle SLO histograms (docs/OBSERVABILITY.md
+        # "Request lifecycle"): fed from host-side perf_counter stamps at
+        # existing emission points — zero device syncs added to the tick
+        # loop. Observations carry the request id as a bucket exemplar.
+        self._m_ttft = reg.histogram(
+            "dl4jtpu_decode_ttft_seconds",
+            "Time-to-first-token: submit to first emitted token, queue "
+            "wait included (the prefill-dominated serving SLO).",
+            ("engine",)).labels(**lab)
+        self._m_itl = reg.histogram(
+            "dl4jtpu_decode_itl_seconds",
+            "Inter-token latency: wall between consecutive emitted "
+            "tokens; speculative runs contribute one sample per accepted "
+            "token (run wall / run length).", ("engine",)).labels(**lab)
+        self._m_queue = reg.histogram(
+            "dl4jtpu_decode_queue_seconds",
+            "Admission queue wait: submit to slot claim.",
+            ("engine",)).labels(**lab)
+        # the wide-event request journal (terminal record per request,
+        # completions AND rejections) served at GET /requests
+        self.journal = RequestLog(journal_capacity)
         self._m_version = reg.gauge(
             "dl4jtpu_model_version",
             "Version of the weights currently serving (0 = the model's "
@@ -710,6 +756,7 @@ class DecodeEngine:
                 self._kv_blocked = False
         for r in pending + live:
             if not r.future.done():
+                self._journal_terminal(r, "error")
                 r.future.set_exception(err)
 
     def warmup(self, aot: Optional[str] = None):
@@ -921,9 +968,12 @@ class DecodeEngine:
     # ------------------------------------------------------------ scheduler
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                seed: int = 0, temperature: float = 0.0,
-               top_k: int = 0) -> Future:
+               top_k: int = 0, request_id: Optional[str] = None,
+               tenant: str = "default", priority: str = "normal") -> Future:
         """Enqueue one generation request; returns a Future resolving to
-        ``{"tokens": [...], "prompt_len": int}``."""
+        ``{"tokens": [...], "prompt_len": int}``. ``request_id`` /
+        ``tenant`` / ``priority`` ride into the request's wide-event
+        journal record (and histogram exemplars)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must contain at least one token id")
@@ -944,9 +994,15 @@ class DecodeEngine:
         if self._stop.is_set() and self._thread is not None:
             raise BatcherStoppedError("decode engine stopped")
         fut = Future()
-        req = _Request(prompt, max_new_tokens, seed, temperature, top_k, fut)
+        ctx = get_context()
+        req = _Request(prompt, max_new_tokens, seed, temperature, top_k, fut,
+                       rid=request_id, tenant=tenant, priority=priority,
+                       trace_id=ctx.trace_id if ctx is not None else None)
         with self._cv:
             if len(self._queue) >= self.max_queue:
+                # a rejected request still leaves exactly one terminal
+                # wide event — the journal never under-counts sheds
+                self._journal_terminal(req, "shed")
                 raise ServerOverloadedError(
                     f"decode queue full ({self.max_queue})")
             self._queue.append(req)
@@ -955,10 +1011,13 @@ class DecodeEngine:
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 32,
                  seed: int = 0, temperature: float = 0.0,
-                 top_k: int = 0, timeout: Optional[float] = None) -> dict:
+                 top_k: int = 0, timeout: Optional[float] = None,
+                 request_id: Optional[str] = None, tenant: str = "default",
+                 priority: str = "normal") -> dict:
         """Blocking ``submit`` — the one-call API the HTTP endpoint uses."""
         return self.submit(prompt, max_new_tokens, seed, temperature,
-                           top_k).result(timeout=timeout)
+                           top_k, request_id=request_id, tenant=tenant,
+                           priority=priority).result(timeout=timeout)
 
     def _admit_locked(self):
         if self._pending_swap is not None:
@@ -983,6 +1042,8 @@ class DecodeEngine:
                     break
             self._queue.popleft()
             self._slot_reqs[i] = r
+            r.t_admit = time.perf_counter()
+            self._m_queue.observe(r.t_admit - r.t_start, exemplar=r.rid)
         if self._pool is not None:
             self._kv_blocked = blocked
 
@@ -1000,7 +1061,13 @@ class DecodeEngine:
         need = blocks_for_span(plen + r.max_new - 1, bs)
         shared, cow, skip = [], None, 0
         if self._prefix is not None:
+            r0 = (self._m_host_restores.value
+                  if self._host_tier is not None else 0)
             shared, cow, skip = self._prefix.match(r.prompt)
+            if self._host_tier is not None:
+                # match runs serially on the loop thread, so the counter
+                # delta is exactly this request's tier promotions
+                r.host_restores = int(self._m_host_restores.value - r0)
         try:
             fresh = self._pool.alloc(need - len(shared))
         except PoolExhaustedError:
@@ -1019,6 +1086,7 @@ class DecodeEngine:
             self._m_prefix_saved.inc(skip)
         r.kv_blocks = shared + fresh
         r.cursor = skip                  # prefill resumes past the reuse
+        r.prefix_hit = skip
         row = self._tables[slot]
         row[:] = 0
         row[:need] = r.kv_blocks
@@ -1037,6 +1105,56 @@ class DecodeEngine:
             self._pool.decref(b)
         r.kv_blocks = []
         self._tables[slot][:] = 0
+
+    # ------------------------------------------------------ wide events
+    def _journal_terminal(self, r, outcome, kv_peak: int = 0):
+        """Append the request's ONE terminal wide event (completions and
+        rejections alike). Pure host-side bookkeeping — no device work."""
+        now = time.perf_counter()
+        phases = {}
+        if r.t_admit is not None:
+            phases["queue"] = r.t_admit - r.t_start
+            if r.t_first is not None:
+                phases["prefill"] = r.t_first - r.t_admit
+                phases["decode"] = (r.t_last or r.t_first) - r.t_first
+        else:
+            phases["queue"] = now - r.t_start
+        if r.verify_s:
+            phases["verify"] = r.verify_s
+        rec = new_record(
+            r.rid, "decode",
+            trace_id=r.trace_id, outcome=outcome,
+            tenant=r.tenant, priority=r.priority,
+            engine=self.id, model_version=self._version,
+            tokens_in=len(r.prompt), tokens_out=len(r.generated),
+            wall_seconds=(r.t_last or now) - r.t_start,
+            ttft_seconds=(r.t_first - r.t_start
+                          if r.t_first is not None else None),
+            first_prefill_chunk_seconds=(r.t_prefill0 - r.t_start
+                                         if r.t_prefill0 is not None
+                                         else None),
+            phases=phases)
+        if self._spec is not None:
+            rec["spec"] = {"drafted": r.drafted, "accepted": r.accepted}
+        if self._pool is not None:
+            rec["kv"] = {"peak_blocks": kv_peak,
+                         "prefix_hit_depth": r.prefix_hit,
+                         "host_restores": r.host_restores}
+        self.journal.append(rec)
+
+    def _finish(self, slot, r, outcome):
+        """Terminal accounting for one completed stream (loop thread):
+        KV peak is captured BEFORE the release clears the block list,
+        the slot is freed, the wide event lands, the future resolves."""
+        kv_peak = len(r.kv_blocks)
+        if self._pool is not None:
+            self._release_kv(slot, r)
+        with self._cv:
+            self._slot_reqs[slot] = None   # freed; wiped on re-claim
+        self._m_requests.inc()
+        self._journal_terminal(r, outcome, kv_peak=kv_peak)
+        r.future.set_result({"tokens": r.generated,
+                             "prompt_len": len(r.prompt)})
 
     # ----------------------------------------- host-side block movement
     # Migration, spill, and restore move KV as HOST bytes: one numpy
@@ -1285,6 +1403,7 @@ class DecodeEngine:
                     pstart = np.zeros(S, np.int32)
                     pn = np.zeros(S, np.int32)
                     preset = np.zeros(S, bool)
+                    t_chunk = time.perf_counter()
                     for i, r in pre:
                         k = min(K, len(r.prompt) - 1 - r.cursor)
                         ptok[i, :k] = r.prompt[r.cursor:r.cursor + k]
@@ -1293,6 +1412,8 @@ class DecodeEngine:
                         preset[i] = r.fresh
                         r.fresh = False
                         r.cursor += k
+                        if r.t_prefill0 is None:
+                            r.t_prefill0 = t_chunk
                     with trace.span("decode_prefill", chunks=len(pre)):
                         self._dstate = self._prefill(
                             params, state, self._dstate,
@@ -1347,29 +1468,37 @@ class DecodeEngine:
             self._m_steps.inc()
             self._m_occupancy.set(len(live))
             self._m_token_seconds.observe(dt)
+            now = t0 + dt                        # the post-sync host stamp
             done = []
             for i, r in live:
                 r.cursor += 1
                 if r.cursor < len(r.prompt):
+                    if r.t_prefill0 is None:
+                        r.t_prefill0 = now
                     continue                     # still prefilling
                 tok = int(nt[i])
                 r.generated.append(tok)
                 self._m_tokens.inc()
+                if r.t_first is None:
+                    if r.t_prefill0 is None:
+                        r.t_prefill0 = now       # 1-token prompt: the
+                    r.t_first = now              # prefill WAS this step
+                    self._m_ttft.observe(now - r.t_start, exemplar=r.rid)
+                else:
+                    self._m_itl.observe(now - r.t_last, exemplar=r.rid)
+                r.t_last = now
                 if ((self.eos_id is not None and tok == self.eos_id)
                         or len(r.generated) >= r.max_new
                         or r.cursor >= self.max_len):
-                    done.append((i, r))
-            for i, r in done:
-                if self._pool is not None:
-                    # full release on eos/length: every claimed block's
-                    # refcount returns to the pool (prefix-cached blocks
-                    # park in the evictable LRU, everything else frees)
-                    self._release_kv(i, r)
-                with self._cv:
-                    self._slot_reqs[i] = None    # freed; wiped on re-claim
-                self._m_requests.inc()
-                r.future.set_result({"tokens": r.generated,
-                                     "prompt_len": len(r.prompt)})
+                    outcome = ("eos" if (self.eos_id is not None
+                                         and tok == self.eos_id)
+                               else "max_new")
+                    done.append((i, r, outcome))
+            for i, r, outcome in done:
+                # full release on eos/length: every claimed block's
+                # refcount returns to the pool (prefix-cached blocks
+                # park in the evictable LRU, everything else frees)
+                self._finish(i, r, outcome)
         self._m_occupancy.set(0)
 
     # ------------------------------------------------------- speculative tick
@@ -1493,6 +1622,8 @@ class DecodeEngine:
             self._m_steps.inc()
             for i, r in tpre:
                 r.cursor += 1
+                if r.t_prefill0 is None:
+                    r.t_prefill0 = t0 + dt
         done = []
         if ready:
             vtok = np.zeros((S, tr.n_nodes), np.int32)
@@ -1533,6 +1664,7 @@ class DecodeEngine:
                 etoks, acc, emit, sacc, self._dstate = self._verifier.run(
                     params, state, self._dstate, *vargs)
             dt = time.perf_counter() - t0
+            now = t0 + dt                       # one stamp per verify run
             self._decode_seconds += dt
             self._m_steps.inc()
             self._m_token_seconds.observe(dt)
@@ -1543,9 +1675,12 @@ class DecodeEngine:
                 # rate's ceiling at 1.0 for full spine acceptance
                 drafted += min(tr.d, n_in)
                 accepted += int(acc[i])
+                r.drafted += min(tr.d, n_in)
+                r.accepted += int(acc[i])
+                r.verify_s += dt
                 self._m_spec_depth.observe(float(acc[i]))
                 p0 = r.cursor
-                consumed, finished = 0, False
+                consumed, finished, fin_eos = 0, False, False
                 for j in range(int(emit[i])):
                     tok = int(etoks[i, j])
                     r.generated.append(tok)
@@ -1555,7 +1690,25 @@ class DecodeEngine:
                             or len(r.generated) >= r.max_new
                             or r.cursor + consumed >= self.max_len):
                         finished = True
+                        fin_eos = (self.eos_id is not None
+                                   and tok == self.eos_id)
                         break
+                if consumed:
+                    # a verify emits an accepted RUN at one host point:
+                    # one ITL sample per accepted token (run wall spread
+                    # over the run), TTFT on the stream's first token
+                    per = (now - (r.t_last if r.t_last is not None
+                                  else r.t_start)) / consumed
+                    if r.t_first is None:
+                        r.t_first = now
+                        self._m_ttft.observe(now - r.t_start,
+                                             exemplar=r.rid)
+                        n_itl = consumed - 1
+                    else:
+                        n_itl = consumed
+                    for _ in range(n_itl):
+                        self._m_itl.observe(per, exemplar=r.rid)
+                    r.t_last = now
                 r.cursor += consumed
                 # draft resync: its carry snapshots follow its OWN spine,
                 # valid through the spine-consistent accepted prefix —
@@ -1566,23 +1719,34 @@ class DecodeEngine:
                 r.draft_cursor = p0 + js + 1
                 r.draft_sel = js
                 if finished:
-                    done.append((i, r))
+                    done.append((i, r, "eos" if fin_eos else "max_new"))
             self._m_spec_drafted.inc(drafted)
             self._m_spec_accepted.inc(accepted)
             tot = self._m_spec_drafted.value
             self._m_spec_rate.set(
                 self._m_spec_accepted.value / tot if tot else 0.0)
         self._m_occupancy.set(len(live))
-        for i, r in done:
-            if self._pool is not None:
-                self._release_kv(i, r)
-            with self._cv:
-                self._slot_reqs[i] = None    # freed; wiped on re-claim
-            self._m_requests.inc()
-            r.future.set_result({"tokens": r.generated,
-                                 "prompt_len": len(r.prompt)})
+        for i, r, outcome in done:
+            self._finish(i, r, outcome)
 
     # --------------------------------------------------------------- stats
+    def _slo_stats(self) -> dict:
+        """Request-lifecycle SLO snapshot: percentiles + the per-bucket
+        last-exemplar request ids that link a bucket back to its journal
+        record (docs/OBSERVABILITY.md "Request lifecycle")."""
+        def block(h):
+            out = {"count": int(h.count)}
+            for q, key in ((0.5, "p50_ms"), (0.99, "p99_ms")):
+                p = h.percentile(q)
+                out[key] = round(p * 1e3, 4) if p is not None else None
+            out["exemplars"] = [
+                ["+Inf" if b == float("inf") else b, rid, v]
+                for b, rid, v in h.exemplars()]
+            return out
+        return {"ttft": block(self._m_ttft),
+                "itl": block(self._m_itl),
+                "queue": block(self._m_queue)}
+
     def stats(self) -> dict:
         with self._cv:
             occupied = sum(r is not None for r in self._slot_reqs)
@@ -1645,6 +1809,11 @@ class DecodeEngine:
                 "decode_seconds": self._decode_seconds,
                 "tokens_per_second": (toks / self._decode_seconds
                                       if self._decode_seconds else 0.0),
+                "slo": self._slo_stats(),
+                "journal": {"capacity": self.journal.capacity,
+                            "records": len(self.journal),
+                            "total": self.journal.total,
+                            "dropped": self.journal.dropped},
                 "warmup_seconds": self.warmup_seconds}
 
 

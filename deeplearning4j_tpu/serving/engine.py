@@ -418,10 +418,15 @@ class InferenceEngine:
             return np.pad(a, widths)
         return jnp.pad(a, widths)
 
-    def _dispatch(self, inputs: Sequence, mask=None) -> List:
+    def _dispatch(self, inputs: Sequence, mask=None, phases=None) -> List:
         """One bucketed device call: pad → run → slice. Returns the list of
         output device arrays (async — not yet host-read). Batches larger
-        than ``max_batch`` are chunked through the top bucket."""
+        than ``max_batch`` are chunked through the top bucket.
+
+        ``phases``: optional dict the call ACCUMULATES wall seconds into
+        under ``bucket``/``pad``/``device`` keys — the per-batch phase
+        attribution the micro-batcher's wide-event records carry
+        (docs/OBSERVABILITY.md "Request lifecycle")."""
         n = inputs[0].shape[0]
         if n > self.max_batch:
             # each chunk recurses through THIS method, so the tail chunk
@@ -430,17 +435,27 @@ class InferenceEngine:
             # never hit the pad-waste counter below
             pieces = [self._dispatch(
                 [x[i:i + self.max_batch] for x in inputs],
-                None if mask is None else mask[i:i + self.max_batch])
+                None if mask is None else mask[i:i + self.max_batch],
+                phases=phases)
                 for i in range(0, n, self.max_batch)]
             return [jnp.concatenate([p[j] for p in pieces])
                     for j in range(len(pieces[0]))]
         if not self._in_warmup:
             self._size_counts[n] = self._size_counts.get(n, 0) + 1
+        tp = time.perf_counter()
         with trace.span("bucket", n=n):
             b = bucket_for(n, self.max_batch, self.min_bucket, self.ladder)
+        if phases is not None:
+            t = time.perf_counter()
+            phases["bucket"] = phases.get("bucket", 0.0) + (t - tp)
+            tp = t
         with trace.span("pad", bucket=b):
             padded = [self._pad_rows(x, b) for x in inputs]
             mask_p = None if mask is None else self._pad_rows(mask, b)
+        if phases is not None:
+            t = time.perf_counter()
+            phases["pad"] = phases.get("pad", 0.0) + (t - tp)
+            tp = t
         with trace.span("device", bucket=b):
             params, state = self._weights()
             prog = self._aot.get((b, mask_p is not None))
@@ -466,32 +481,44 @@ class InferenceEngine:
             get_programs().record(
                 self.id, key, self._fwd, (params, state, padded, mask_p),
                 compile_seconds=time.perf_counter() - t0)
+        if phases is not None:
+            t = time.perf_counter()
+            phases["device"] = phases.get("device", 0.0) + (t - tp)
         self._m_rows.inc(n)
         self._m_pad_rows.inc(b - n)
         return [o[:n] for o in outs]
 
     # ----------------------------------------------------------- public API
-    def predict(self, x, mask=None):
+    def predict(self, x, mask=None, phases=None):
         """Bucketed forward. ``x``: one batch array, or a list of input
         arrays for multi-input graphs; returns device array(s) shaped like
         the model's own ``output()`` (slicing already applied). The call is
-        async — reading the result to the host is the caller's sync point."""
+        async — reading the result to the host is the caller's sync point.
+        ``phases``: optional dict accumulating bucket/pad/device wall
+        seconds (see ``_dispatch``)."""
         single = not isinstance(x, (list, tuple))
         inputs = [jnp.asarray(x)] if single else [jnp.asarray(a) for a in x]
         if mask is not None:
             mask = jnp.asarray(mask)
-        outs = self._dispatch(inputs, mask)
+        outs = self._dispatch(inputs, mask, phases=phases)
         if self._is_graph:
             return outs[0] if len(outs) == 1 else outs
         return outs[0]
 
-    def predict_host(self, x, mask=None):
-        """``predict`` + host read; returns np.ndarray (or list of them)."""
-        out = self.predict(x, mask)
+    def predict_host(self, x, mask=None, phases=None):
+        """``predict`` + host read; returns np.ndarray (or list of them).
+        With ``phases``, the host read lands under ``readback``."""
+        out = self.predict(x, mask, phases=phases)
+        t0 = time.perf_counter() if phases is not None else 0.0
         with trace.span("readback"):
             if isinstance(out, list):
-                return [np.asarray(o) for o in out]
-            return np.asarray(out)
+                out = [np.asarray(o) for o in out]
+            else:
+                out = np.asarray(out)
+        if phases is not None:
+            phases["readback"] = (phases.get("readback", 0.0)
+                                  + (time.perf_counter() - t0))
+        return out
 
     def predict_stream(self, batches, depth: int = 2):
         """Pipelined inference over an iterable of batches: keeps up to

@@ -104,7 +104,8 @@ def build_server(model_name: str = "charlstm", port: int = 0,
                  spec_tree: Optional[str] = None,
                  spec_self_draft: Optional[str] = None,
                  role: str = "mixed",
-                 host_kv_bytes: Optional[int] = None):
+                 host_kv_bytes: Optional[int] = None,
+                 journal_capacity: int = 512):
     """Assemble (but don't start) a replica InferenceServer. ``charlstm``
     serves both /predict and /generate; ``mlp`` is predict-only.
     ``precision`` (None = the executor policy / DL4JTPU_PRECISION) puts
@@ -127,7 +128,8 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     migration, and — with ``host_kv_bytes`` — the host-memory KV tier.
     ``role`` declares the replica's disaggregation specialization
     (prefill | decode | mixed), advertised via /stats for the router's
-    role-aware placement."""
+    role-aware placement. ``journal_capacity`` bounds the wide-event
+    request journals (predict + decode) served at ``GET /requests``."""
     from deeplearning4j_tpu.serving.decode import DecodeEngine
     from deeplearning4j_tpu.serving.engine import InferenceEngine
     from deeplearning4j_tpu.serving.server import InferenceServer
@@ -150,7 +152,8 @@ def build_server(model_name: str = "charlstm", port: int = 0,
                            kv=kv, kv_block_size=kv_block_size,
                            kv_blocks=kv_blocks, prefix_cache=prefix_cache,
                            chunk_tokens=chunk_tokens,
-                           host_kv_bytes=host_kv_bytes, spec=spec)
+                           host_kv_bytes=host_kv_bytes, spec=spec,
+                           journal_capacity=journal_capacity)
     injector = None
     if chaos:
         from deeplearning4j_tpu.resilience.faults import ServerFaultInjector
@@ -158,7 +161,7 @@ def build_server(model_name: str = "charlstm", port: int = 0,
     return InferenceServer(net, port=port, max_latency_ms=max_latency_ms,
                            max_queue=max_queue, engine=eng,
                            decode_engine=dec, fault_injector=injector,
-                           role=role)
+                           role=role, journal_capacity=journal_capacity)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -184,6 +187,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--max-len", type=int, default=64)
     parser.add_argument("--max-queue", type=int, default=256)
     parser.add_argument("--max-latency-ms", type=float, default=2.0)
+    parser.add_argument("--journal-capacity", type=int, default=512,
+                        help="wide-event request journal ring size per "
+                             "engine (GET /requests); oldest dropped first")
     parser.add_argument("--chaos", action="store_true",
                         help="mount POST /chaos (test-only fault injection)")
     parser.add_argument("--warmup", action="store_true",
@@ -263,7 +269,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                        spec_draft=args.spec_draft, spec_k=args.spec_k,
                        spec_tree=args.spec_tree,
                        spec_self_draft=args.spec_self_draft,
-                       role=args.role, host_kv_bytes=args.host_kv_bytes)
+                       role=args.role, host_kv_bytes=args.host_kv_bytes,
+                       journal_capacity=args.journal_capacity)
     # warmup BEFORE the serve loops start so REPLICA_READY / the port-file
     # handshake mean genuinely ready-to-serve: with --aot this is a
     # millisecond restore, without it the full trace-and-save

@@ -59,10 +59,11 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.monitor import get_registry, trace
 from deeplearning4j_tpu.monitor import tracing
+from deeplearning4j_tpu.monitor.reqlog import RequestLog, new_record
 from deeplearning4j_tpu.monitor.slo import BurnRateSLO
 from deeplearning4j_tpu.serving.client import InferenceClient
 from deeplearning4j_tpu.serving.kv.prefix import chain_hashes
@@ -226,6 +227,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # the router process's span ring buffer; merged with every
             # replica's by monitor/collect.collect_fleet_trace
             self._reply(200, json.dumps(trace.export()).encode())
+        elif path == "/requests":
+            # the router's wide-event annotation journal; merged with
+            # every replica's by monitor/collect.collect_requests
+            q = parse_qs(urlparse(self.path).query)
+            n = q.get("n", [None])[0]
+            try:
+                n = None if n is None else int(n)
+            except ValueError:
+                self._reply(400, json.dumps(
+                    {"error": {"type": "bad_request",
+                               "message": f"n must be an integer, "
+                                          f"got {n!r}"}}).encode())
+                return
+            self._reply(200,
+                        json.dumps(router.journal.snapshot(n)).encode())
         elif path == "/metrics":
             data = get_registry().render().encode()
             self.send_response(200)
@@ -290,6 +306,7 @@ class Router:
                  prefix_affinity: bool = True,
                  affinity_max_chain: int = 32,
                  affinity_slack: int = 2,
+                 journal_capacity: int = 512,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         if not upstreams and hold_for_capacity_s <= 0:
@@ -326,6 +343,10 @@ class Router:
         self.affinity_max_chain = int(affinity_max_chain)
         self.affinity_slack = int(affinity_slack)
         self._replicas: Dict[str, _Replica] = {}
+        # router-side wide events: one annotation record per routed
+        # request (attempts, hedge winner, affinity hit) that the fleet
+        # collector joins to the replica records by base request id
+        self.journal = RequestLog(journal_capacity)
         self._lock = threading.Lock()
         self._rr = itertools.count()
         self._rid_counter = itertools.count(1)
@@ -795,9 +816,19 @@ class Router:
         to the client. Exposed directly (not just via HTTP) so tests can
         drive the router without sockets where sockets add nothing."""
         rid = self._mint_rid(request_id)
+        t_start = time.perf_counter()
         self.budget.deposit()
         shed = self._admit(tenant, priority, rid)
         if shed is not None:
+            # wide event even for a request that never reached an
+            # upstream: a shed MUST be attributable in the journal
+            self.journal.append(new_record(
+                rid, "router", trace_id=rid, outcome="shed",
+                tenant=tenant, priority=priority, router=self.id,
+                path=path, status=shed[0], attempts=0, attempt_rids=[],
+                hedged=False, hedge_winner=None, affinity_hit=None,
+                replica=None,
+                wall_seconds=time.perf_counter() - t_start))
             return shed
         # the fleet trace root: trace_id = the router-minted request id.
         # Every span below (route here, attempt per upstream try, and —
@@ -811,7 +842,8 @@ class Router:
                 hedge = self.hedge_enabled and path == "/predict"
                 hint = self._affinity_hint(path, body)
                 return self._forward(path, body, rid, expires, hedge,
-                                     hint=hint)
+                                     hint=hint, tenant=tenant,
+                                     priority=priority, t_start=t_start)
         finally:
             self._release(tenant)
 
@@ -870,16 +902,23 @@ class Router:
         return "5xx"
 
     def _forward(self, path: str, body: bytes, rid: str,
-                 expires: Optional[float], hedge: bool, hint=None):
+                 expires: Optional[float], hedge: bool, hint=None,
+                 tenant: str = "default", priority: str = "normal",
+                 t_start: Optional[float] = None):
         results: "queue.Queue" = queue.Queue()
         live: List[_Attempt] = []
         tried = set()
         n_attempt = itertools.count()
+        t_start = time.perf_counter() if t_start is None else t_start
+        attempt_rids: List[str] = []
+        aff_hit: Optional[bool] = None
+        hedged = False
 
         ctx = tracing.get_context()
 
         def launch(rep: _Replica) -> None:
             att = _Attempt(rep, f"{rid}#a{next(n_attempt)}")
+            attempt_rids.append(att.rid)
             tried.add(rep.url)
             live.append(att)
             self._pool.submit(self._run_attempt, att, path, body, results,
@@ -888,6 +927,19 @@ class Router:
         def outcome(tag: str):
             self._m_requests.labels(router=self.id, path=path,
                                     outcome=tag).inc()
+
+        def journal(tag: str, status, replica=None, winner=None):
+            # the router's half of the wide event: per-attempt fan-out the
+            # replica journals can't see, joined fleet-wide by base rid
+            self.journal.append(new_record(
+                rid, "router", trace_id=rid, outcome=tag, tenant=tenant,
+                priority=priority, router=self.id, path=path,
+                status=None if status is None else int(status),
+                attempts=len(attempt_rids),
+                attempt_rids=list(attempt_rids), hedged=hedged,
+                hedge_winner=winner, affinity_hit=aff_hit,
+                replica=replica,
+                wall_seconds=time.perf_counter() - t_start))
 
         want_prefill = self.prefix_affinity and path == "/generate"
         primary = self._pick(tried, hint=hint, want_prefill=want_prefill)
@@ -898,14 +950,16 @@ class Router:
         if primary is None:
             outcome("shed")
             self._m_sheds.labels(router=self.id, reason="no_replicas").inc()
+            journal("shed", 503)
             return self._err(503, "no_healthy_replicas",
                              "no routable replica", rid)
         if hint is not None:
             # counted on the primary pick only — failover/hedge picks are
             # health decisions, not affinity decisions
+            aff_hit = bool(hint.get(primary.url))
             self._m_affinity.labels(
                 router=self.id,
-                outcome="hit" if hint.get(primary.url) else "miss").inc()
+                outcome="hit" if aff_hit else "miss").inc()
         launch(primary)
         hedge_at = (time.perf_counter() + self._hedge_delay_s()
                     if hedge else None)
@@ -919,6 +973,7 @@ class Router:
                     att.cancel()
                 outcome("error")
                 self._m_sheds.labels(router=self.id, reason="deadline").inc()
+                journal("deadline", 504)
                 return self._err(504, "deadline_exceeded",
                                  "request deadline expired at the router",
                                  rid)
@@ -965,11 +1020,14 @@ class Router:
                 if hedged and not att.rid.endswith("#a0"):
                     self._m_hedges.labels(router=self.id,
                                           outcome="won").inc()
-                    outcome("hedge_win")
+                    tag = "hedge_win"
                 elif failed_over:
-                    outcome("failed_over")
+                    tag = "failed_over"
                 else:
-                    outcome("ok")
+                    tag = "ok"
+                outcome(tag)
+                journal(tag, status, replica=rep.url,
+                        winner=att.rid if tag == "hedge_win" else None)
                 extra = {}
                 mv = next((v for k, v in (hdrs or {}).items()
                            if k.lower() == "x-model-version"), None)
@@ -985,12 +1043,14 @@ class Router:
             nxt = self._pick(tried)
             if nxt is None:
                 outcome("error")
+                journal("error", 502)
                 return self._err(
                     502, "upstream_failed",
                     "every routable replica failed this request "
                     f"(last: {exc or status})", rid)
             if not self.budget.try_spend():
                 outcome("error")
+                journal("error", 503)
                 return self._err(
                     503, "retry_budget_exhausted",
                     "upstream failed and the shared retry budget is "
@@ -1091,4 +1151,8 @@ class Router:
                 "retry_budget_balance": round(self.budget.balance, 3),
                 "hedge_delay_ms": round(self._hedge_delay_s() * 1e3, 2),
                 "total_outstanding": self._total_outstanding,
-                "tenants": dict(self._tenant_outstanding)}
+                "tenants": dict(self._tenant_outstanding),
+                "journal": {"capacity": self.journal.capacity,
+                            "records": len(self.journal),
+                            "total": self.journal.total,
+                            "dropped": self.journal.dropped}}

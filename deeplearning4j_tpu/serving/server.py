@@ -36,12 +36,13 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -60,7 +61,7 @@ from deeplearning4j_tpu.serving.kv import KVMigrateError
 _KNOWN_PATHS = ("/predict", "/generate", "/warmup", "/stats", "/metrics",
                 "/healthz", "/chaos", "/admin/swap", "/trace", "/programs",
                 "/admin/profile", "/train/diagnostics", "/kv/export",
-                "/kv/import")
+                "/kv/import", "/requests")
 
 
 def _http_metrics():
@@ -91,8 +92,20 @@ class _Handler(BaseHTTPRequestHandler):
     def _rid(self):
         # router-assigned x-request-id: echoed on every response and into
         # error bodies + trace spans, so one grep follows a request across
-        # the router, both halves of a hedged pair, and the replica
-        return self.headers.get("x-request-id")
+        # the router, both halves of a hedged pair, and the replica.
+        # Direct-to-replica requests with no id get one MINTED here, so
+        # they're never anonymous in the journal or the traces; the mint
+        # is cached against this request's header object (fresh per
+        # request even on a keep-alive connection), so every response
+        # header and journal record of one request agrees.
+        rid = self.headers.get("x-request-id")
+        if rid:
+            return rid
+        minted = getattr(self, "_rid_minted", None)
+        if minted is None or minted[0] is not self.headers:
+            minted = (self.headers, self.server.inference.mint_rid())
+            self._rid_minted = minted
+        return minted[1]
 
     def _json(self, obj, code=200, extra_headers=None):
         data = json.dumps(obj).encode()
@@ -165,6 +178,19 @@ class _Handler(BaseHTTPRequestHandler):
                 # this process's span ring buffer as one Chrome trace-event
                 # document — what monitor/collect.py pulls per process
                 self._json(trace.export())
+            elif path == "/requests":
+                # the wide-event request journal (predict + decode rings
+                # merged on one timeline) — what collect_requests pulls
+                # per replica; ?n= bounds the tail
+                q = parse_qs(urlparse(self.path).query)
+                n = q.get("n", [None])[0]
+                try:
+                    n = None if n is None else int(n)
+                except ValueError:
+                    self._error(400, "bad_request",
+                                f"n must be an integer, got {n!r}")
+                    return
+                self._json(srv.request_journal(n))
             elif path == "/programs":
                 from deeplearning4j_tpu.exec.programs import get_programs
                 self._json({"programs": get_programs().entries()})
@@ -330,7 +356,11 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
         # block=False: a full queue answers 429 NOW — the handler thread is
         # never parked on backpressure while the client waits
-        fut = srv.batcher.submit(x, deadline_ms=deadline_ms, block=False)
+        fut = srv.batcher.submit(
+            x, deadline_ms=deadline_ms, block=False,
+            request_id=self._rid,
+            tenant=self.headers.get("x-tenant", "default"),
+            priority=self.headers.get("x-priority", "normal"))
         out = fut.result()
         if squeeze:
             out = out[0]
@@ -397,7 +427,10 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=int(payload.get("max_new_tokens", 32)),
                 seed=int(payload.get("seed", 0)),
                 temperature=float(payload.get("temperature", 0.0)),
-                top_k=int(payload.get("top_k", 0)))
+                top_k=int(payload.get("top_k", 0)),
+                request_id=self._rid,
+                tenant=self.headers.get("x-tenant", "default"),
+                priority=self.headers.get("x-priority", "normal"))
         except ValueError as e:     # capacity / id-range problems → 400
             raise BadRequestError(str(e)) from None
         self._json(out, extra_headers={
@@ -424,7 +457,8 @@ class InferenceServer:
                  request_timeout_ms: Optional[float] = None,
                  decode_engine=None, fault_injector=None,
                  health_hook=None, request_mirror=None,
-                 flight_recorder=None, role: str = "mixed"):
+                 flight_recorder=None, role: str = "mixed",
+                 journal_capacity: int = 512):
         if role not in ("prefill", "decode", "mixed"):
             raise ValueError(
                 f"role must be 'prefill', 'decode' or 'mixed', got {role!r}")
@@ -455,7 +489,8 @@ class InferenceServer:
         self.flight_recorder = flight_recorder
         self.batcher = MicroBatcher(self.engine, max_batch=max_batch,
                                     max_latency_ms=max_latency_ms,
-                                    max_queue=max_queue)
+                                    max_queue=max_queue,
+                                    journal_capacity=journal_capacity)
         self.request_timeout_ms = request_timeout_ms
         self._port_req = port
         self._host = host
@@ -493,6 +528,14 @@ class InferenceServer:
             bad_fn=lambda: sum(c.value for c in bad),
             total_fn=lambda: sum(c.value for c in sli),
             objective=0.99)
+        # request-id mint for direct-to-replica requests (no router, no
+        # client-supplied id): pid + server instance keeps ids unique
+        # across a local fleet so the merged journal never mis-joins
+        self._rid_prefix = f"{os.getpid():x}-{self.id}"
+        self._rid_counter = itertools.count(1)
+
+    def mint_rid(self) -> str:
+        return f"req-{self._rid_prefix}-{next(self._rid_counter):06d}"
 
     # --------------------------------------------------------------- health
     def note_engine_error(self, e: BaseException) -> None:
@@ -580,6 +623,25 @@ class InferenceServer:
         if self.decode_engine is not None:
             out["decode"] = self.decode_engine.stats()
         return out
+
+    def request_journal(self, n: Optional[int] = None) -> dict:
+        """The wide-event journal this replica serves at ``GET
+        /requests?n=``: the /predict (batcher) and /generate (decode)
+        rings merged onto one ``ts`` timeline, newest last."""
+        logs = [self.batcher.journal]
+        if self.decode_engine is not None:
+            logs.append(self.decode_engine.journal)
+        recs, total, dropped = [], 0, 0
+        for lg in logs:
+            snap = lg.snapshot()
+            recs.extend(snap["records"])
+            total += snap["total"]
+            dropped += snap["dropped"]
+        recs.sort(key=lambda r: r.get("ts") or 0.0)
+        if n is not None:
+            recs = recs[-n:] if n > 0 else []
+        return {"server": self.id, "total": total, "dropped": dropped,
+                "records": recs}
 
     # ------------------------------------------------------------- hot swap
     def swap_weights(self, params, state=None,

@@ -1,0 +1,389 @@
+"""Request-lifecycle observability (monitor/reqlog + SLO histograms).
+
+The load-bearing claims pinned here:
+- the wide-event ring is bounded: oldest record dropped first, ``total``
+  keeps counting so ``dropped = total - len`` stays visible;
+- every rejection path — batcher queue-full (429), stopped (503),
+  deadline (504), decode queue-full (429) — leaves EXACTLY ONE terminal
+  journal record with the right outcome;
+- the InferenceServer mints ``x-request-id`` when the client sent none,
+  echoes it in the response header, and the journal record joins on it;
+- a concurrent /generate storm honors the ring bound and every kept
+  record's phase durations (queue/prefill/decode) are non-negative and
+  sum to the record's wall, which never exceeds the client's wall;
+- /predict wide events carry queue/bucket/pad/device/readback phase
+  attribution and the tenant/priority identity headers;
+- fleet merge (the ISSUE-18 acceptance bar): a 3-replica router
+  /generate storm collected with ``collect_requests`` yields one merged
+  entry per request with the router's annotation joined by base rid,
+  and a replica's worst ITL bucket exemplar resolves to a concrete
+  journal record;
+- ``tools/tail_requests.py`` runs clean against the live fleet.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitor.collect import collect_requests
+from deeplearning4j_tpu.monitor.reqlog import RequestLog, new_record
+from deeplearning4j_tpu.resilience.errors import (
+    BatcherStoppedError, DeadlineExceededError, ServerOverloadedError)
+from deeplearning4j_tpu.serving import (InferenceClient, InProcessReplica,
+                                        Router)
+from deeplearning4j_tpu.clustering.knn_server import ndarray_to_b64
+from deeplearning4j_tpu.serving.batcher import MicroBatcher
+
+X = np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+# ------------------------------------------------------------- ring buffer
+
+def test_reqlog_ring_oldest_first_drop_and_accounting():
+    log = RequestLog(capacity=4)
+    for i in range(10):
+        log.append(new_record(f"r{i}", "predict", outcome="ok"))
+    assert len(log) == 4
+    assert log.total == 10
+    assert log.dropped == 6
+    # oldest dropped first: exactly the newest four survive, oldest-first
+    assert [r["request_id"] for r in log.tail(10)] == ["r6", "r7", "r8", "r9"]
+    assert [r["request_id"] for r in log.tail(2)] == ["r8", "r9"]
+    assert log.tail(0) == []
+    assert log.find("r9")["request_id"] == "r9"
+    assert log.find("r0") is None                 # dropped off the ring
+    snap = log.snapshot(2)
+    assert snap["capacity"] == 4 and snap["total"] == 10
+    assert snap["dropped"] == 6                   # ring-level, not n-slice
+    assert [r["request_id"] for r in snap["records"]] == ["r8", "r9"]
+    # identity defaults every writer relies on
+    rec = new_record(None, "decode")
+    assert rec["tenant"] == "default" and rec["priority"] == "normal"
+    assert rec["outcome"] is None and abs(rec["ts"] - time.time()) < 5.0
+
+
+# --------------------------------------------------- rejection wide events
+
+class _IdentityEngine:
+    """Bare predict_host without ``phases=`` — exercises the batcher's
+    capability fallback alongside the rejection paths."""
+
+    def predict_host(self, x):
+        return np.asarray(x)
+
+
+def test_batcher_rejections_one_terminal_record_each():
+    # queue-full (429): park the worker so nothing drains, fill the queue
+    mb = MicroBatcher(_IdentityEngine(), max_queue=1, journal_capacity=8)
+    mb._thread = threading.current_thread()       # sentinel: never drains
+    mb.submit(X, request_id="fills-queue")
+    with pytest.raises(ServerOverloadedError):
+        mb.submit(X, block=False, request_id="gets-shed", tenant="acme")
+    shed = [r for r in mb.journal.tail() if r["outcome"] == "shed"]
+    assert len(shed) == 1
+    assert shed[0]["request_id"] == "gets-shed"
+    assert shed[0]["tenant"] == "acme" and shed[0]["source"] == "predict"
+    assert mb.journal.total == 1                  # the queued one is live
+
+    # stopped (503): a post-stop submit fails fast and journals "error"
+    mb2 = MicroBatcher(_IdentityEngine(), journal_capacity=8)
+    mb2.start()
+    mb2.stop()
+    with pytest.raises(BatcherStoppedError):
+        mb2.submit(X, request_id="too-late")
+    errs = [r for r in mb2.journal.tail() if r["outcome"] == "error"]
+    assert len(errs) == 1 and errs[0]["request_id"] == "too-late"
+
+    # deadline (504): expired before dispatch, answered without the device
+    mb3 = MicroBatcher(_IdentityEngine(), journal_capacity=8).start()
+    try:
+        fut = mb3.submit(X, deadline_ms=0.0, request_id="expired")
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=10.0)
+        dead = [r for r in mb3.journal.tail() if r["outcome"] == "deadline"]
+        assert len(dead) == 1 and dead[0]["request_id"] == "expired"
+        # the served path still works after, with its own single record
+        ok = mb3.submit(X, request_id="served").result(timeout=10.0)
+        assert ok.shape == X.shape
+        assert [r["request_id"] for r in mb3.journal.tail()
+                if r["outcome"] == "ok"] == ["served"]
+        assert mb3.journal.total == 2
+    finally:
+        mb3.stop()
+
+
+# ----------------------------------------------------------- HTTP replicas
+
+@pytest.fixture(scope="module")
+def mlp_rep():
+    rep = InProcessReplica(model="mlp").start()
+    yield rep
+    rep.stop()
+
+
+@pytest.fixture(scope="module")
+def lstm_rep():
+    rep = InProcessReplica(model="charlstm", slots=2, max_len=32).start()
+    yield rep
+    rep.stop()
+
+
+def _post(url, path, payload, headers=None):
+    c = InferenceClient(url, retries=1)
+    try:
+        return c.post_raw(path, json.dumps(payload).encode(),
+                          headers=headers)
+    finally:
+        c.close()
+
+
+def test_server_mints_and_echoes_request_id(lstm_rep):
+    gen = {"tokens": [1, 2, 3], "max_new_tokens": 4}
+    # no x-request-id from the client: the server mints one and echoes it
+    st, _, hdrs = _post(lstm_rep.url, "/generate", gen)
+    assert st == 200
+    minted = hdrs.get("x-request-id")
+    assert minted and minted.startswith("req-")
+    # a client-supplied id is echoed verbatim, never re-minted
+    st, _, hdrs = _post(lstm_rep.url, "/generate", gen,
+                        headers={"x-request-id": "my-rid-7"})
+    assert st == 200 and hdrs.get("x-request-id") == "my-rid-7"
+    # both land in the journal, joined on the id
+    st, body = _get_json(f"{lstm_rep.url}/requests")
+    assert st == 200
+    by_rid = {r["request_id"]: r for r in body["records"]}
+    assert minted in by_rid and "my-rid-7" in by_rid
+    assert by_rid["my-rid-7"]["source"] == "decode"
+    assert by_rid["my-rid-7"]["outcome"] == "max_new"
+    # minted ids are unique per request
+    st, _, hdrs = _post(lstm_rep.url, "/generate", gen)
+    assert st == 200 and hdrs.get("x-request-id") not in (None, minted)
+    # ?n= caps the reply; junk n is a 400, not a crash
+    st, body = _get_json(f"{lstm_rep.url}/requests?n=1")
+    assert st == 200 and len(body["records"]) == 1
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{lstm_rep.url}/requests?n=junk", timeout=10)
+    assert ei.value.code == 400
+
+
+def test_predict_wide_event_phases_and_tenant(mlp_rep):
+    payload = {"ndarray": ndarray_to_b64(X)}
+    st, _, hdrs = _post(mlp_rep.url, "/predict", payload,
+                        headers={"x-request-id": "pred-1",
+                                 "x-tenant": "acme",
+                                 "x-priority": "batch"})
+    assert st == 200 and hdrs.get("x-request-id") == "pred-1"
+    st, body = _get_json(f"{mlp_rep.url}/requests")
+    rec = {r["request_id"]: r for r in body["records"]}["pred-1"]
+    assert rec["source"] == "predict" and rec["outcome"] == "ok"
+    assert rec["tenant"] == "acme" and rec["priority"] == "batch"
+    assert rec["rows"] == 3 and rec["batch"] >= 1
+    phases = rec["phases"]
+    assert set(phases) >= {"queue", "bucket", "pad", "device", "readback"}
+    assert all(v >= 0.0 for v in phases.values())
+    # phase attribution can't exceed the request's own wall (the queue
+    # phase is per-rider; bucket/pad/device/readback are the merged call)
+    assert phases["queue"] <= rec["wall_seconds"] + 1e-3
+
+
+def test_decode_queue_full_429_leaves_one_shed_record(lstm_rep):
+    eng = lstm_rep.srv.decode_engine
+    before = eng.journal.total
+    saved = eng.max_queue
+    eng.max_queue = 0                             # every submit sheds
+    try:
+        st, body, hdrs = _post(lstm_rep.url, "/generate",
+                               {"tokens": [1, 2], "max_new_tokens": 2},
+                               headers={"x-request-id": "shed-me"})
+    finally:
+        eng.max_queue = saved
+    assert st == 429, body
+    assert hdrs.get("x-request-id") == "shed-me"  # echoed even on errors
+    assert eng.journal.total == before + 1        # exactly one record
+    rec = eng.journal.find("shed-me")
+    assert rec is not None and rec["outcome"] == "shed"
+    assert rec["tokens_out"] == 0 and rec["phases"]["queue"] >= 0.0
+
+
+def test_generate_storm_ring_bound_and_phase_walls():
+    cap, n_req = 6, 12
+    rep = InProcessReplica(model="charlstm", slots=2, max_len=32,
+                           journal_capacity=cap).start()
+    try:
+        walls, errs, lock = {}, [], threading.Lock()
+
+        def worker(i):
+            rid = f"storm-{i:02d}"
+            t0 = time.perf_counter()
+            try:
+                st, body, _ = _post(rep.url, "/generate",
+                                    {"tokens": [1 + i % 4, 2],
+                                     "max_new_tokens": 4},
+                                    headers={"x-request-id": rid})
+                assert st == 200, body
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                with lock:
+                    errs.append(e)
+                return
+            with lock:
+                walls[rid] = time.perf_counter() - t0
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_req)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        assert len(walls) == n_req
+
+        st, body = _get_json(f"{rep.url}/requests")
+        assert st == 200
+        recs = body["records"]
+        # ring bound honored under concurrency; the accounting shows it
+        assert len(recs) <= cap
+        assert body["total"] == n_req
+        assert body["dropped"] == n_req - len(recs)
+        # newest survive the wrap (oldest-first drop), served oldest-first
+        tss = [r["ts"] for r in recs]
+        assert tss == sorted(tss)
+        for rec in recs:
+            assert rec["request_id"] in walls
+            assert rec["outcome"] == "max_new"
+            assert rec["tokens_out"] == 4
+            ph = rec["phases"]
+            assert set(ph) >= {"queue", "prefill", "decode"}
+            assert all(v >= 0.0 for v in ph.values())   # monotone stamps
+            # the phases ARE the wall: queue+prefill+decode partition
+            # submit..last-token exactly (verify only rides spec engines)
+            core = ph["queue"] + ph["prefill"] + ph["decode"]
+            assert abs(core - rec["wall_seconds"]) < 1e-3
+            # and the server-side wall fits inside the client's wall
+            assert rec["wall_seconds"] <= walls[rec["request_id"]] + 0.05
+            assert rec["ttft_seconds"] is not None
+            assert rec["ttft_seconds"] <= rec["wall_seconds"] + 1e-6
+    finally:
+        rep.stop()
+
+
+# ------------------------------------------------------------- fleet merge
+
+def test_fleet_journal_merge_exemplar_resolution_and_tail_cli(tmp_path):
+    """3-replica router /generate storm (the ISSUE's fleet acceptance
+    bar): the merged journal has ONE entry per request with the router's
+    annotation joined by base rid, a replica's worst ITL bucket exemplar
+    resolves to a concrete merged record, and tail_requests.py runs
+    clean against the live fleet."""
+    reps = [InProcessReplica(model="charlstm", slots=4, max_len=32).start()
+            for _ in range(3)]
+    router = None
+    try:
+        # warm each engine directly so the routed storm never waits on an
+        # XLA compile (hedges would fire on compile latency, not load)
+        for r in reps:
+            st, body, _ = _post(r.url, "/generate",
+                                {"tokens": [1, 2], "max_new_tokens": 2})
+            assert st == 200, body
+        router = Router([r.url for r in reps], port=0, probe_interval=None,
+                        upstream_timeout=60.0).start()
+        base = f"http://127.0.0.1:{router.port}"
+
+        rids = [f"fleet-{i:02d}" for i in range(9)]
+        errs, lock = [], threading.Lock()
+
+        def worker(rid, tok):
+            try:
+                st, body, hdrs = _post(base, "/generate",
+                                       {"tokens": [tok, 2],
+                                        "max_new_tokens": 6},
+                                       headers={"x-request-id": rid})
+                assert st == 200, body
+                assert hdrs.get("x-request-id") == rid
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                with lock:
+                    errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(rid, 1 + i % 4))
+              for i, rid in enumerate(rids)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+
+        out = str(tmp_path / "fleet_requests.json")
+        doc = collect_requests(base, path=out)
+        assert len(doc["collectedFrom"]) == 4     # router + 3 replicas
+        mine = {e["request_id"]: e for e in doc["requests"]
+                if e["request_id"] in set(rids)}
+        # one merged entry per request, router annotation joined by rid
+        assert sorted(mine) == rids
+        for rid, entry in mine.items():
+            rt = entry["router"]
+            assert rt is not None, f"{rid} missing its router annotation"
+            assert rt["outcome"] in ("ok", "hedge_win", "failed_over")
+            assert rt["status"] == 200
+            assert rt["attempts"] >= 1
+            assert all(a.split("#", 1)[0] == rid
+                       for a in rt["attempt_rids"])
+            assert entry["attempts"], f"{rid} has no replica record"
+            att = entry["attempts"][0]
+            assert att["source"] == "decode"
+            assert att["tokens_out"] == 6
+        # the worst ITL bucket exemplar names a real, resolvable request
+        by_base = {e["request_id"]: e for e in doc["requests"]}
+        resolved = 0
+        for r in reps:
+            exs = InferenceClient(r.url).stats()[
+                "decode"]["slo"]["itl"]["exemplars"]
+            if not exs:
+                continue
+            _, ex_rid, ex_val = exs[-1]           # highest populated bucket
+            entry = by_base.get(ex_rid.split("#", 1)[0])
+            assert entry is not None, f"exemplar {ex_rid} resolves nowhere"
+            assert entry["attempts"] and ex_val >= 0.0
+            resolved += 1
+        assert resolved >= 1, "no replica produced an ITL exemplar"
+        # the on-disk doc is loadable and carries the same merge
+        with open(out) as f:
+            assert len(json.load(f)["requests"]) == len(doc["requests"])
+
+        # tail CLI smoke against the live fleet
+        tool = Path(__file__).resolve().parent.parent / "tools"
+        r1 = subprocess.run(
+            [sys.executable, str(tool / "tail_requests.py"), base,
+             "--slowest", "3"],
+            capture_output=True, text=True, timeout=120)
+        assert r1.returncode == 0, r1.stderr
+        assert len(r1.stdout.strip().splitlines()) == 3
+        r2 = subprocess.run(
+            [sys.executable, str(tool / "tail_requests.py"), base,
+             "--outcome", "max_new", "--tenant", "default"],
+            capture_output=True, text=True, timeout=120)
+        assert r2.returncode == 0, r2.stderr
+        assert any(rid in r2.stdout for rid in rids)
+        r3 = subprocess.run(
+            [sys.executable, str(tool / "collect_requests.py"), base,
+             "-o", str(tmp_path / "cli_requests.json")],
+            capture_output=True, text=True, timeout=120)
+        assert r3.returncode == 0, r3.stderr
+        assert json.loads((tmp_path / "cli_requests.json").read_text())[
+            "requests"]
+    finally:
+        if router is not None:
+            router.stop()
+        for r in reps:
+            r.stop()

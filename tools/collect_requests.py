@@ -1,0 +1,60 @@
+"""Collect the merged fleet request journal from a running serving tier.
+
+Pulls ``GET /requests`` from the router and from every replica it knows
+about (discovered via the router's ``/stats``), and joins the wide-event
+records by request id: the router's annotation (attempts, hedge winner,
+affinity hit) plus each attempt's replica-side record (phases, tokens,
+spec/KV accounting) become ONE entry per request — the fleet-wide
+answer to "what exactly happened to request X".
+
+    python tools/collect_requests.py http://127.0.0.1:9400 -o requests.json
+
+``router`` may also be a plain replica URL — you just get that one
+process's journal. For a human-readable view of the same merge, see
+tools/tail_requests.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    from deeplearning4j_tpu.monitor.collect import collect_requests
+
+    ap = argparse.ArgumentParser(
+        description="Merge router + replica wide-event request journals "
+                    "into one document, joined by request id.")
+    ap.add_argument("router", help="router base URL, e.g. "
+                                   "http://127.0.0.1:9400")
+    ap.add_argument("-o", "--out", default="fleet_requests.json",
+                    help="output path (default: fleet_requests.json)")
+    ap.add_argument("-n", type=int, default=None,
+                    help="pull only the newest N records per process")
+    ap.add_argument("--extra", nargs="*", default=(), metavar="URL",
+                    help="additional /requests endpoints not in the "
+                         "router's replica set")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="per-endpoint fetch timeout in seconds")
+    args = ap.parse_args(argv)
+
+    doc = collect_requests(args.router, extra_urls=args.extra, n=args.n,
+                           path=args.out, timeout=args.timeout)
+    reqs = doc["requests"]
+    annotated = sum(1 for r in reqs if r["router"] is not None)
+    print(f"wrote {args.out}: {len(reqs)} request(s) "
+          f"({annotated} router-annotated) from "
+          f"{len(doc.get('collectedFrom', []))} endpoint(s)")
+    if not reqs:
+        print("no records collected — has the tier served any traffic?",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

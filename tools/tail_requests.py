@@ -1,0 +1,126 @@
+"""Tail the fleet's wide-event request journal, human-readably.
+
+Pulls and merges ``GET /requests`` across the tier (router annotation +
+replica records joined by request id, exactly what
+tools/collect_requests.py writes as JSON) and prints one line per
+request: id, outcome, tenant, wall, and the phase breakdown — the
+five-second answer to "which requests were slow and where did the time
+go".
+
+    python tools/tail_requests.py http://127.0.0.1:9400
+    python tools/tail_requests.py http://127.0.0.1:9400 --outcome shed
+    python tools/tail_requests.py http://127.0.0.1:9400 --slowest 10
+
+``router`` may also be a plain replica URL (no router annotations then).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _wall_ms(entry) -> float:
+    """Best wall estimate for one merged entry: the router's end-to-end
+    wall when annotated, else the slowest attempt's."""
+    rt = entry.get("router")
+    if rt is not None and rt.get("wall_seconds") is not None:
+        return rt["wall_seconds"] * 1e3
+    walls = [a.get("wall_seconds") or 0.0 for a in entry["attempts"]]
+    return max(walls) * 1e3 if walls else 0.0
+
+
+def _outcomes(entry):
+    rt = entry.get("router")
+    if rt is not None and rt.get("outcome"):
+        yield rt["outcome"]
+    for a in entry["attempts"]:
+        if a.get("outcome"):
+            yield a["outcome"]
+
+
+def _tenant(entry) -> str:
+    rt = entry.get("router")
+    if rt is not None and rt.get("tenant"):
+        return rt["tenant"]
+    for a in entry["attempts"]:
+        if a.get("tenant"):
+            return a["tenant"]
+    return "default"
+
+
+def _detail(entry) -> str:
+    parts = []
+    rt = entry.get("router")
+    if rt is not None:
+        bits = [f"attempts={rt.get('attempts')}"]
+        if rt.get("hedge_winner"):
+            bits.append(f"hedge_winner={rt['hedge_winner']}")
+        if rt.get("affinity_hit") is not None:
+            aff = "hit" if rt["affinity_hit"] else "miss"
+            bits.append(f"affinity={aff}")
+        parts.append("router(" + " ".join(bits) + ")")
+    for a in entry["attempts"]:
+        ph = a.get("phases") or {}
+        phase_s = " ".join(f"{k}={v * 1e3:.2f}ms"
+                           for k, v in ph.items())
+        extra = ""
+        if a.get("source") == "decode":
+            extra = (f" tokens={a.get('tokens_in')}→"
+                     f"{a.get('tokens_out')}")
+            if a.get("spec"):
+                extra += (f" spec={a['spec'].get('accepted')}/"
+                          f"{a['spec'].get('drafted')}")
+        parts.append(f"{a.get('source')}[{a.get('outcome')}]"
+                     f"{extra} {phase_s}".rstrip())
+    return " | ".join(parts)
+
+
+def main(argv=None) -> int:
+    from deeplearning4j_tpu.monitor.collect import collect_requests
+
+    ap = argparse.ArgumentParser(
+        description="Pretty-print the merged fleet request journal.")
+    ap.add_argument("router", help="router (or replica) base URL")
+    ap.add_argument("-n", type=int, default=None,
+                    help="pull only the newest N records per process")
+    ap.add_argument("--outcome", default=None,
+                    help="only requests with this outcome anywhere in "
+                         "their records (e.g. ok, shed, deadline, error)")
+    ap.add_argument("--tenant", default=None,
+                    help="only requests from this tenant")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="the N slowest requests by wall time, "
+                         "slowest first")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="per-endpoint fetch timeout in seconds")
+    args = ap.parse_args(argv)
+
+    doc = collect_requests(args.router, n=args.n, timeout=args.timeout)
+    entries = doc["requests"]
+    if args.outcome is not None:
+        entries = [e for e in entries if args.outcome in set(_outcomes(e))]
+    if args.tenant is not None:
+        entries = [e for e in entries if _tenant(e) == args.tenant]
+    if args.slowest is not None:
+        entries = sorted(entries, key=_wall_ms,
+                         reverse=True)[:max(args.slowest, 0)]
+
+    for e in entries:
+        outs = list(dict.fromkeys(_outcomes(e)))
+        print(f"{e['request_id']:<28} {'/'.join(outs) or '?':<12} "
+              f"{_tenant(e):<10} {_wall_ms(e):9.2f}ms  {_detail(e)}")
+    print(f"-- {len(entries)} request(s) shown "
+          f"({len(doc['requests'])} merged) from "
+          f"{len(doc.get('collectedFrom', []))} endpoint(s)",
+          file=sys.stderr)
+    if not doc["requests"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
