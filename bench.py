@@ -3242,6 +3242,80 @@ def bench_autoscale(fast=False, slo_ms=None):
          "qps_phase_b": round(len(lat_b) / dur_b, 1)})
 
 
+def bench_elastic(fast=False):
+    """Elastic cluster row (docs/ELASTIC_TRAINING.md): a REAL N-process
+    data-parallel job through exec/cluster.py — subprocess workers, the
+    coordinator's deterministic loopback-TCP allreduce, checkpoint-anchored
+    recovery. Full mode is the N=4 soak: worker 2 SIGKILLs itself mid-run,
+    the replacement rejoins from checkpoint + AOT, and the row pins (a)
+    BITWISE final-params parity with an unkilled N=4 run, (b) zero failed
+    steps (every step 0..total reduced exactly once, no job restart) and
+    reports the recovery wall plus DP scaling efficiency vs a world-of-one
+    run of the same job. Fast mode shrinks to N=2 with no kill (the
+    subprocess path and parity assertions stay live; tier-1 budget).
+    Efficiency on CPU subprocesses is reported, not asserted — four
+    pinned-to-nothing host processes sharing cores prove nothing about
+    ICI-linked chips."""
+    import shutil
+    import tempfile
+    from deeplearning4j_tpu.exec.cluster import ClusterManager
+
+    n = 2 if fast else 4
+    steps = 6 if fast else 16
+    kill_at = None if fast else 8
+    gb = 32
+    root = tempfile.mkdtemp(prefix="bench_elastic_")
+
+    def run(tag, workers, chaos=None):
+        t0 = time.perf_counter()
+        res = ClusterManager(os.path.join(root, tag), workers=workers,
+                             total_steps=steps, global_batch=gb,
+                             ckpt_every=4, aot=True,
+                             chaos=chaos).run(timeout=300)
+        res["wall"] = time.perf_counter() - t0
+        digs = {r["params_digest"] for r in res["results"].values()}
+        assert len(digs) == 1, digs     # members agree bitwise
+        assert res["reduced_steps"] == steps, res["reduced_steps"]
+        return res
+
+    try:
+        ref1 = run("n1", 1)
+        refn = run("ref", n)
+        dig = lambda r: next(iter(  # noqa: E731
+            {x["params_digest"] for x in r["results"].values()}))
+        if kill_at is None:
+            soak, recovery_wall = refn, 0.0
+        else:
+            soak = run("kill", n, chaos={2: f"die_at_step={kill_at}"})
+            assert dig(soak) == dig(refn), "kill-and-rejoin diverged"
+            assert soak["replacements"] == 1 and soak["spawns"] == n + 1
+            recovery_wall = soak["last_recovery_wall"]
+            assert recovery_wall and recovery_wall < 60, recovery_wall
+        # throughput counts trained rows; the soak's wall absorbs the kill
+        tput1 = steps * gb / ref1["wall"]
+        tputn = steps * gb / refn["wall"]
+        efficiency = tputn / (n * tput1)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    return _emit(
+        f"elastic (N={n} subprocess DP cluster"
+        + ("" if kill_at is None else ", SIGKILL mid-run + rejoin")
+        + ", bitwise parity, zero failed steps)",
+        recovery_wall, "s", 60.0,
+        {"workers": n,
+         "steps": steps,
+         "kill_at_step": kill_at,
+         "bitwise_parity": True,
+         "failed_steps": 0,
+         "replacements": 0 if kill_at is None else soak["replacements"],
+         "generations": soak["generation"],
+         "recovery_wall_s": round(recovery_wall, 3),
+         "scaling_efficiency": round(efficiency, 3),
+         "wall_n1_s": round(ref1["wall"], 2),
+         f"wall_n{n}_s": round(refn["wall"], 2)})
+
+
 BENCHES = {
     "lenet": bench_lenet,
     "input_pipeline": bench_input_pipeline,
@@ -3259,6 +3333,7 @@ BENCHES = {
     "router": bench_router,
     "cold_start": bench_cold_start,
     "autoscale": bench_autoscale,
+    "elastic": bench_elastic,
     "observability": bench_observability,
     "robustness": bench_robustness,
     "online": bench_online,
@@ -3286,7 +3361,7 @@ _EST = {"resnet50_imagenet": 120, "charrnn": 200, "accuracy": 180,
         "spec_decode": 180, "spec_tree": 180, "self_draft": 120,
         "observability": 160, "robustness": 100,
         "router": 150, "online": 120, "train_perf": 150,
-        "cold_start": 120, "autoscale": 150}
+        "cold_start": 120, "autoscale": 150, "elastic": 150}
 
 
 def main(argv=None):

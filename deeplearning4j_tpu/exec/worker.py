@@ -1,0 +1,614 @@
+"""Elastic data-parallel training worker (one process, one cluster member).
+
+``python -m deeplearning4j_tpu.exec.worker --coordinator URL --worker-id w0
+--port-file /run/w0.port`` joins the ElasticCoordinator (exec/elastic.py),
+builds the deterministic job model, and trains lockstep data-parallel
+steps until ``total_steps``:
+
+- **Deterministic shards.** Every worker materializes the SAME global
+  batch from ``(seed, step)`` and takes its committed-rank slice
+  (``parallel.distributed.local_batch_slice``) — re-sharding after an
+  elastic reform is just a different slice of the same bytes.
+- **Reduction.** Grad + loss ravel into one f32 vector, pre-scaled by the
+  shard's row count; the coordinator sums contributions in rank order and
+  divides by the total rows (``docs/ELASTIC_TRAINING.md``). With
+  ``DL4JTPU_CLUSTER_BACKEND=jax`` (and a jaxlib whose backend actually
+  ships cross-process collectives) the same vector goes through a real
+  ``process_allgather`` and is summed in the same rank order — identical
+  math, in-mesh transport. jaxlib CPU wheels ship no such collectives, so
+  CI exercises the loopback-TCP path — which is the point: a REAL
+  N-process cluster instead of a skip.
+- **Elasticity.** A heartbeat thread renews the lease; any fenced RPC or
+  rollback directive sends the worker to ``_resync``: restore the anchor
+  checkpoint (bitwise, PR 4), ack the proposed generation, resume at the
+  anchor step under the committed (rank, world). Replacements walk the
+  same path from scratch — join, restore anchor, AOT-restore the train
+  programs from the checkpoint's companion bundle, continue — which is
+  why a killed-and-replaced run finishes bitwise-equal to an unkilled
+  one.
+- **Chaos.** ``resilience.faults.WorkerChaos`` (env
+  ``DL4JTPU_WORKER_CHAOS``) injects per-step slowdowns and scripted
+  self-SIGKILL for the soak tests.
+
+Exit codes: 0 done, 3 evicted (a replacement took the seat), 4 cluster
+full, 5 fatal config/setup error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.exec.elastic import (ClusterFullError, EvictedError,
+                                             FencedError)
+from deeplearning4j_tpu.resilience.errors import TransientError
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+
+__all__ = ["CoordClient", "ElasticWorker", "synth_batch", "params_digest",
+           "main"]
+
+# one bundle-validity envelope for the cluster's train programs (grad is
+# shape-specialized per shard-row count, update is shape-stable)
+_AOT_PRECISION = "cluster-f32"
+
+_RPC_POLICY = RetryPolicy(max_attempts=6, base_delay=0.05, max_delay=1.0)
+# the allreduce blocks server-side until the barrier fills; retries are
+# idempotent (the coordinator caches reduced steps), so ride out stragglers
+# with an overall deadline instead of an attempt cap
+_REDUCE_POLICY = RetryPolicy(max_attempts=None, base_delay=0.1,
+                             max_delay=1.0, deadline=240.0)
+
+
+def synth_batch(model: str, seed: int, step: int, n: int):
+    """The deterministic GLOBAL batch for ``step`` — a pure function of
+    ``(model, seed, step)`` so every member (including a replacement that
+    joined five generations later) slices identical bytes."""
+    rng = np.random.default_rng([int(seed), int(step), 0xE1A])
+    if model == "mlp":
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        labels = rng.integers(0, 3, size=n)
+        y = np.zeros((n, 3), np.float32)
+        y[np.arange(n), labels] = 1.0
+        return x, y
+    raise ValueError(f"no synthetic batch source for model {model!r} "
+                     "(elastic cluster jobs are mlp)")
+
+
+def params_digest(params) -> str:
+    """Order-stable hash of every parameter leaf's bytes — the bitwise
+    fit-parity witness the soak compares across killed/unkilled runs."""
+    import jax
+    h = hashlib.blake2b(digest_size=16)
+    for leaf in jax.tree_util.tree_leaves(params):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------------------
+# coordinator client
+# --------------------------------------------------------------------------
+
+class CoordClient:
+    """HTTP adapter to the ElasticCoordinator: every RPC goes through the
+    shared retry primitive (``component="cluster"``), and coordinator
+    verdicts come back as the elastic exceptions (409 stale_generation →
+    FencedError, 410 → EvictedError) so the worker's control flow never
+    parses status codes."""
+
+    def __init__(self, base_url: str, worker_id: str, timeout: float = 5.0):
+        self.base = base_url.rstrip("/")
+        self.worker_id = worker_id
+        self.timeout = timeout
+
+    # -- transport ---------------------------------------------------------
+    def _raise_mapped(self, e: urllib.error.HTTPError):
+        try:
+            doc = json.loads(e.read().decode() or "{}")
+        except Exception:   # noqa: BLE001 — unparseable body: keep HTTPError
+            raise e from None
+        kind = doc.get("error")
+        if kind == "stale_generation":
+            raise FencedError(doc.get("message", "fenced"),
+                              proposal=doc.get("proposal"),
+                              anchor=doc.get("anchor")) from None
+        if kind == "evicted":
+            raise EvictedError(doc.get("message", "evicted")) from None
+        if kind == "cluster_full":
+            raise ClusterFullError(doc.get("message", "full")) from None
+        if kind == "barrier_timeout":
+            raise TransientError(doc.get("message", "barrier")) from None
+        raise e
+
+    def _post_once(self, path: str, body: bytes, headers: Dict[str, str],
+                   timeout: float) -> bytes:
+        req = urllib.request.Request(self.base + path, data=body,
+                                     headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            self._raise_mapped(e)
+            raise   # pragma: no cover — _raise_mapped always raises
+
+    def _rpc(self, path: str, doc: dict, *, policy=_RPC_POLICY,
+             timeout: Optional[float] = None) -> dict:
+        body = json.dumps(doc).encode()
+        out = retry_call(self._post_once, path, body,
+                         {"Content-Type": "application/json"},
+                         timeout or self.timeout,
+                         policy=policy, component="cluster")
+        return json.loads(out or b"{}")
+
+    # -- RPCs --------------------------------------------------------------
+    def join(self) -> dict:
+        return self._rpc("/join", {"worker_id": self.worker_id})
+
+    def sync(self, generation: int) -> dict:
+        return self._rpc("/sync", {"worker_id": self.worker_id,
+                                   "generation": int(generation)})
+
+    def heartbeat(self, generation: int, step: int) -> dict:
+        return self._rpc("/heartbeat", {"worker_id": self.worker_id,
+                                        "generation": int(generation),
+                                        "step": int(step)})
+
+    def anchor(self, generation: int, step: int,
+               path: Optional[str]) -> dict:
+        return self._rpc("/anchor", {"worker_id": self.worker_id,
+                                     "generation": int(generation),
+                                     "step": int(step), "path": path})
+
+    def result(self, payload: dict) -> None:
+        self._rpc("/result", {"worker_id": self.worker_id,
+                              "result": payload})
+
+    def leave(self) -> None:
+        self._rpc("/leave", {"worker_id": self.worker_id})
+
+    def state(self) -> dict:
+        with urllib.request.urlopen(self.base + "/state",
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def allreduce(self, generation: int, step: int, rows: int,
+                  vec: np.ndarray) -> np.ndarray:
+        """Post this member's pre-scaled vector; block until the reduced
+        one comes back. Socket timeout > the coordinator's barrier wait so
+        the server, not the client, decides a barrier is stuck."""
+        headers = {"Content-Type": "application/octet-stream",
+                   "X-Worker": self.worker_id,
+                   "X-Gen": str(int(generation)),
+                   "X-Step": str(int(step)), "X-Rows": str(int(rows))}
+        body = np.ascontiguousarray(vec, dtype=np.float32).tobytes()
+        out = retry_call(self._post_once, "/allreduce", body, headers, 75.0,
+                         policy=_REDUCE_POLICY, component="cluster")
+        return np.frombuffer(out, dtype=np.float32)
+
+
+# --------------------------------------------------------------------------
+# worker
+# --------------------------------------------------------------------------
+
+class _LeaseBox:
+    """What the heartbeat thread learned last, for the train loop to poll
+    between steps (lock-guarded; the two threads share nothing else)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.generation = 0
+        self.step = 0
+        self.directive = "none"
+        self.proposal: Optional[int] = None
+        self.evicted = False
+
+    def snapshot(self):
+        with self._lock:
+            return (self.directive, self.proposal, self.evicted)
+
+    def set_progress(self, generation: int, step: int):
+        with self._lock:
+            self.generation, self.step = generation, step
+
+    def absorb(self, resp: dict):
+        with self._lock:
+            self.directive = resp.get("directive", "none")
+            self.proposal = resp.get("proposal")
+
+    def mark_evicted(self):
+        with self._lock:
+            self.evicted = True
+
+
+class ElasticWorker:
+    """One cluster member's whole lifecycle: join → sync → train → result.
+
+    ``clock``/network injection happens in the coordinator; the worker is
+    deliberately plain — everything interesting about elasticity lives in
+    how it reacts to FencedError (resync at the anchor) and EvictedError
+    (exit; the seat belongs to a replacement now).
+    """
+
+    def __init__(self, coordinator: str, worker_id: str,
+                 port_file: Optional[str] = None):
+        self.client = CoordClient(coordinator, worker_id)
+        self.worker_id = worker_id
+        self.port_file = port_file
+        self.box = _LeaseBox()
+        self.cfg: dict = {}
+        self.net = None
+        self.generation = 0
+        self.rank: Optional[int] = None
+        self.world = 0
+        self.anchor: dict = {"step": 0, "path": None}
+        self.step = 0
+        self.last_loss: Optional[float] = None
+        self.aot_restored = 0
+        self.rejoined = False
+        self._grad_jit = None
+        self._upd_jit = None
+        self._grad_exec: Dict[int, object] = {}     # rows → AOT program
+        self._upd_exec = None
+        self._unravel = None
+        self._cm = None
+        self._stop_hb = threading.Event()
+        self._use_jax_collectives = False
+
+    # -- logging -----------------------------------------------------------
+    def _log(self, msg: str):
+        print(f"CLUSTER[{self.worker_id}] {msg}", flush=True)
+
+    # -- heartbeat thread --------------------------------------------------
+    def _hb_loop(self):
+        interval = float(self.cfg.get("hb_interval", 0.25))
+        while not self._stop_hb.wait(interval):
+            try:
+                resp = self.client.heartbeat(self.generation, self.step)
+                self.box.absorb(resp)
+            except EvictedError:
+                self.box.mark_evicted()
+                return
+            except Exception:   # noqa: BLE001 — next beat retries
+                pass
+
+    # -- membership --------------------------------------------------------
+    def _resync(self, proposal: Optional[int]) -> None:
+        """Ack ``proposal`` (or whatever supersedes it) until a generation
+        commits, then roll back to its anchor and adopt its (rank, world).
+        This is THE recovery path: initial formation, post-eviction reform,
+        degraded commit and replacement onboarding all land here."""
+        target = proposal or self.generation or 1
+        interval = float(self.cfg.get("hb_interval", 0.25))
+        while True:
+            if self.box.snapshot()[2]:
+                raise EvictedError(f"{self.worker_id} evicted during sync")
+            resp = self.client.sync(target)
+            if resp.get("status") == "go":
+                break
+            target = resp.get("proposal") or target
+            time.sleep(interval / 2)
+        self.generation = int(resp["generation"])
+        self.rank = int(resp["rank"])
+        self.world = int(resp["world"])
+        self.anchor = dict(resp.get("anchor") or
+                           {"step": 0, "path": None})
+        # rank-tag this process for flight-recorder spills and re-stamp the
+        # elastic topology + generation fence (parallel/distributed.py)
+        os.environ["DL4JTPU_RANK"] = str(self.rank)
+        os.environ["DL4JTPU_WORLD"] = str(self.world)
+        from deeplearning4j_tpu.parallel import distributed as dist
+        dist.initialize(process_id=self.rank, num_processes=self.world,
+                        generation=self.generation)
+        self._restore_anchor()
+        self.step = int(self.anchor.get("step") or 0)
+        self.box.set_progress(self.generation, self.step)
+        # clear any directive a pre-commit heartbeat left behind; a stale
+        # one only costs a harmless replay from the anchor (reduced steps
+        # are cached, so replayed contributions read the same vectors)
+        self.box.absorb({"directive": "none", "proposal": None})
+        self._log(f"generation={self.generation} rank={self.rank} "
+                  f"world={self.world} anchor_step={self.step}")
+
+    def _restore_anchor(self) -> None:
+        path = self.anchor.get("path")
+        if path and os.path.exists(path):
+            from deeplearning4j_tpu.util.model_serializer import restore_into
+            restore_into(self.net, path)
+            self._maybe_restore_aot(path)
+        else:
+            # no anchor yet: formation at step 0 on the deterministic
+            # seed-built model — identical across members by construction
+            self.net.iteration = 0
+
+    # -- programs ----------------------------------------------------------
+    def _build_programs(self) -> None:
+        import jax
+        net = self.net
+
+        def grad_step(params, state, x, y, rng):
+            (loss, new_state), grads = jax.value_and_grad(
+                net._dp_loss, has_aux=True)(params, state, x, y, rng)
+            return loss, new_state, grads
+
+        def upd(params, opt_state, grads):
+            return net._dp_apply_updates(params, opt_state, grads)
+
+        self._grad_jit = jax.jit(grad_step)
+        # NO donate_argnums on the update: after a rollback the params /
+        # opt_state leaves are numpy arrays zero-copy-aliased by
+        # restore_into, and donating buffers that host memory still aliases
+        # lets XLA recycle them under live arrays — the bytes of
+        # self.net.params then mutate between steps, breaking bitwise
+        # recovery parity (race-dependent; surfaced only under the
+        # cluster's barrier delays + heartbeat thread).
+        self._upd_jit = jax.jit(upd)
+
+    def _model_sig(self) -> str:
+        from deeplearning4j_tpu.exec.aot import model_signature
+        return model_signature(self.net.params, self.net.opt_state)
+
+    def _maybe_restore_aot(self, ckpt_path: str) -> None:
+        """A replacement restores the anchored checkpoint's companion AOT
+        bundle so it re-enters the step loop with ZERO compiles."""
+        if not self.cfg.get("aot", True):
+            return
+        from deeplearning4j_tpu.exec.aot import companion_path, open_bundle
+        bundle, reason = open_bundle(companion_path(ckpt_path),
+                                     self._model_sig(), _AOT_PRECISION)
+        if bundle is None:
+            self._log(f"CLUSTER_AOT miss reason={reason}")
+            return
+        restored = 0
+        for key in sorted(bundle.keys()):
+            prog = bundle.restore(key, engine="cluster")
+            if prog is None:
+                continue
+            if key == "cluster:update":
+                self._upd_exec = prog
+                restored += 1
+            elif key.startswith("cluster:grad:b"):
+                self._grad_exec[int(key.rsplit("b", 1)[1])] = prog
+                restored += 1
+        self.aot_restored += restored
+        self._log(f"CLUSTER_AOT restored={restored}")
+
+    def _export_aot(self, ckpt_path: str, example) -> None:
+        """Rank 0 rides an AOT bundle alongside every anchor checkpoint:
+        grad program at the current shard width + the update program."""
+        from deeplearning4j_tpu.exec.aot import (AotBundle, companion_path,
+                                                 export_compiled)
+        params, state, x, y, rng, grads = example
+        try:
+            bundle = AotBundle(self._model_sig(), _AOT_PRECISION)
+            bundle.add_compiled(f"cluster:grad:b{x.shape[0]}",
+                                export_compiled(self._grad_jit,
+                                                (params, state, x, y, rng)))
+            bundle.add_compiled("cluster:update",
+                                export_compiled(self._upd_jit,
+                                                (params,
+                                                 self.net.opt_state, grads)))
+            bundle.save(companion_path(ckpt_path))
+        except Exception as e:    # noqa: BLE001 — AOT is an accelerant,
+            self._log(f"CLUSTER_AOT export failed: {e}")  # never a blocker
+
+    # -- collectives -------------------------------------------------------
+    def _probe_jax_collectives(self) -> bool:
+        """``DL4JTPU_CLUSTER_BACKEND=jax``: form a real ``jax.distributed``
+        client (address in DL4JTPU_JAX_COORD) and verify a cross-process
+        allgather actually works. jaxlib CPU wheels ship no such
+        collectives, so on CI this probe fails and the loopback-TCP path
+        carries the traffic; on a jaxlib with gloo/real backends the SAME
+        rank-ordered sum runs in-mesh. jax.distributed cannot re-form
+        after a membership change, so any reform drops back to TCP."""
+        if os.environ.get("DL4JTPU_CLUSTER_BACKEND") != "jax":
+            return False
+        addr = os.environ.get("DL4JTPU_JAX_COORD")
+        if not addr:
+            return False
+        try:
+            from deeplearning4j_tpu.parallel import distributed as dist
+            dist.initialize(coordinator_address=addr,
+                            num_processes=self.world,
+                            process_id=self.rank)
+            import jax
+            from jax.experimental import multihost_utils
+            if jax.process_count() != self.world:
+                return False
+            probe = multihost_utils.process_allgather(
+                np.float32(self.rank))
+            return probe.shape[0] == self.world
+        except Exception as e:    # noqa: BLE001 — documented fallback
+            self._log(f"jax collectives unavailable ({e!r}); "
+                      "using loopback-TCP allreduce")
+            return False
+
+    def _reduce(self, rows: int, vec: np.ndarray) -> np.ndarray:
+        if self._use_jax_collectives:
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(vec)
+            rows_all = multihost_utils.process_allgather(
+                np.float32(rows))
+            total = gathered[0].copy()
+            for r in range(1, gathered.shape[0]):   # rank order: bitwise
+                total = total + gathered[r]
+            return np.asarray(total / np.float32(rows_all.sum()))
+        return self.client.allreduce(self.generation, self.step, rows, vec)
+
+    # -- training ----------------------------------------------------------
+    def _train_step(self, chaos) -> None:
+        import jax
+        from jax.flatten_util import ravel_pytree
+
+        from deeplearning4j_tpu.parallel.distributed import local_batch_slice
+        net, cfg, step = self.net, self.cfg, self.step
+        chaos.on_step(step)
+        gb = int(cfg["global_batch"])
+        x, y = synth_batch(cfg["model"], cfg["seed"], step, gb)
+        sl = local_batch_slice(gb, rank=self.rank, world=self.world)
+        rows = sl.stop - sl.start
+        rng = jax.random.fold_in(jax.random.PRNGKey(int(cfg["seed"])), step)
+        fn = self._grad_exec.get(rows, self._grad_jit)
+        loss, new_state, grads = fn(net.params, net.state, x[sl], y[sl], rng)
+        flat, unravel = ravel_pytree(grads)
+        if self._unravel is None:
+            self._unravel = unravel
+        vec = np.concatenate(
+            [np.float32([loss]), np.asarray(flat, np.float32)])
+        reduced = self._reduce(rows, vec * np.float32(rows))
+        self.last_loss = float(reduced[0])
+        mean_grads = self._unravel(np.asarray(reduced[1:], np.float32))
+        upd = self._upd_exec or self._upd_jit
+        if os.environ.get("DL4JTPU_CLUSTER_TRACE"):
+            self._log(f"TRACE-IN step={step} "
+                      f"p={params_digest(net.params)[:8]} "
+                      f"o={params_digest(net.opt_state)[:8]} "
+                      f"g={params_digest(mean_grads)[:8]}")
+        net.params, net.opt_state = upd(net.params, net.opt_state,
+                                        mean_grads)
+        net.state = new_state
+        net.iteration = step + 1
+        self.step = step + 1
+        self.box.set_progress(self.generation, self.step)
+        if os.environ.get("DL4JTPU_CLUSTER_TRACE"):
+            rd = hashlib.blake2b(
+                np.ascontiguousarray(reduced).tobytes(),
+                digest_size=8).hexdigest()
+            self._log(f"TRACE step={step} gen={self.generation} "
+                      f"rows={rows} loss={self.last_loss!r} "
+                      f"reduced={rd} opt={params_digest(net.opt_state)} "
+                      f"digest={params_digest(net.params)}")
+        self._maybe_checkpoint((net.params, net.state, x[sl], y[sl], rng),
+                               mean_grads)
+
+    def _maybe_checkpoint(self, grad_example, grads) -> None:
+        cfg, step = self.cfg, self.step
+        every = int(cfg.get("ckpt_every") or 0)
+        final = step >= int(cfg["total_steps"])
+        if self.rank != 0 or not cfg.get("ckpt_dir"):
+            return
+        if not final and (not every or step % every != 0):
+            return
+        if self._cm is None:
+            from deeplearning4j_tpu.resilience.checkpoint import \
+                CheckpointManager
+            self._cm = CheckpointManager(cfg["ckpt_dir"], keep_last=3)
+        path = self._cm.save(self.net)
+        if cfg.get("aot", True):
+            params, state, x, y, rng = grad_example
+            self._export_aot(path, (params, state, x, y, rng, grads))
+        self._cm.set_anchor(self.net.iteration)
+        self.client.anchor(self.generation, step, path)
+        self.anchor = {"step": step, "path": path}
+        self._log(f"anchor step={step} path={os.path.basename(path)}")
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> int:
+        from deeplearning4j_tpu.resilience.faults import WorkerChaos
+        from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
+        setup_compile_cache()
+        try:
+            joined = self.client.join()
+        except ClusterFullError as e:
+            self._log(f"join rejected: {e}")
+            return 4
+        self.cfg = joined["config"]
+        self.rejoined = bool(joined.get("proposal", 1) > 1)
+        if self.port_file:
+            tmp = self.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{os.getpid()}\n")
+            os.replace(tmp, self.port_file)
+
+        from deeplearning4j_tpu.serving.replica import build_model
+        self.net = build_model(self.cfg["model"])
+        self._build_programs()
+        chaos = WorkerChaos.from_env()
+
+        hb = threading.Thread(target=self._hb_loop, name="cluster-hb",
+                              daemon=True)
+        hb.start()
+        try:
+            self._resync(joined.get("proposal"))
+            self._use_jax_collectives = self._probe_jax_collectives()
+            total = int(self.cfg["total_steps"])
+            while self.step < total:
+                directive, proposal, evicted = self.box.snapshot()
+                if evicted:
+                    raise EvictedError(f"{self.worker_id} lease lost")
+                if directive == "rollback":
+                    self._use_jax_collectives = False
+                    self._resync(proposal)
+                    continue
+                try:
+                    self._train_step(chaos)
+                except FencedError as e:
+                    self._log(f"fenced at step {self.step}: {e}")
+                    self._use_jax_collectives = False
+                    self._resync(e.proposal)
+            self._finish()
+            return 0
+        except EvictedError as e:
+            self._log(f"evicted: {e}")
+            return 3
+        finally:
+            self._stop_hb.set()
+
+    def _finish(self) -> None:
+        payload = {"worker_id": self.worker_id, "rank": self.rank,
+                   "world": self.world, "generation": self.generation,
+                   "steps": self.step, "iteration": self.net.iteration,
+                   "final_loss": self.last_loss,
+                   "params_digest": params_digest(self.net.params),
+                   "aot_restored": self.aot_restored,
+                   "rejoined": self.rejoined}
+        self.client.result(payload)
+        self._log(f"done digest={payload['params_digest']} "
+                  f"loss={self.last_loss}")
+        # hold the lease until every live member reported, so a slightly
+        # slower peer is not evicted into a pointless terminal reform
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                if self.client.state().get("phase") == "done":
+                    return
+            except Exception:   # noqa: BLE001 — coordinator going away is fine
+                return
+            time.sleep(0.1)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="elastic DP training worker")
+    p.add_argument("--coordinator", required=True,
+                   help="ElasticCoordinator base URL")
+    p.add_argument("--worker-id", required=True)
+    p.add_argument("--rank", type=int, default=None,
+                   help="informational spawn rank (committed rank is "
+                        "assigned by the coordinator at each generation)")
+    p.add_argument("--port-file", default=None,
+                   help="written with this worker's pid after a "
+                        "successful join (the spawn handshake)")
+    args = p.parse_args(argv)
+    try:
+        return ElasticWorker(args.coordinator, args.worker_id,
+                             port_file=args.port_file).run()
+    except (ClusterFullError,) as e:
+        print(f"CLUSTER[{args.worker_id}] fatal: {e}", flush=True)
+        return 4
+    except Exception as e:      # noqa: BLE001 — setup/config failures
+        import traceback
+        traceback.print_exc()
+        print(f"CLUSTER[{args.worker_id}] fatal: {e}", flush=True)
+        return 5
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,22 @@ _RATIO_EPS = 1e-12
 
 
 # --------------------------------------------------------------- in-trace
+def rank_tagged_path(path: str) -> str:
+    """Tag a spill filename with this process's cluster rank
+    (``DL4JTPU_RANK``, planted by the elastic worker): ``x.json`` →
+    ``x.rank2.json``. With N workers spilling into a shared run directory,
+    the post-mortem must name WHICH worker diverged — and the tag also
+    stops rank 3's spill from clobbering rank 0's. No-op outside a
+    cluster (env var unset) or when the tag is already present."""
+    rank = os.environ.get("DL4JTPU_RANK", "")
+    if not rank:
+        return path
+    base, ext = os.path.splitext(path)
+    if base.endswith(f".rank{rank}"):
+        return path
+    return f"{base}.rank{rank}{ext}"
+
+
 def _row(old, new, grad):
     """One telemetry row for one layer's (old params, new params, grads)
     subtrees — all-f32 reductions, tolerant of empty (paramless) layers."""
@@ -505,6 +521,7 @@ class FlightRecorder:
         path = path or self.spill_path
         if not path:
             raise ValueError("no spill path configured")
+        path = rank_tagged_path(path)
         doc = {
             "version": self.SPILL_VERSION,
             "layer_names": list(self.layer_names),

@@ -18,11 +18,68 @@ orbax-compatible arrays) rather than task retry.
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+__all__ = ["initialize", "pod_mesh", "local_batch_slice",
+           "set_topology", "topology", "set_generation", "generation",
+           "fence", "StaleGenerationError"]
+
+
+class StaleGenerationError(RuntimeError):
+    """A contribution stamped with a membership generation that has since
+    been superseded (a worker evicted / a replacement joined). The elastic
+    coordinator fences these at the RPC layer; this is the worker-side
+    guard for anything that slipped past it."""
+
+
+# -- elastic topology ------------------------------------------------------
+# The TCP-fallback cluster (exec/cluster.py) never initializes
+# jax.distributed — the jaxlib CPU backend ships no cross-process
+# collectives — so rank/world live here instead of in jax.process_*().
+# The elastic worker re-stamps these at every committed generation.
+_rank: Optional[int] = None
+_world: Optional[int] = None
+_generation: int = 0
+
+
+def set_topology(rank: Optional[int], world: Optional[int]) -> None:
+    """Pin this process's (rank, world) for ``local_batch_slice`` when the
+    cluster membership is coordinator-managed rather than jax-managed.
+    ``(None, None)`` reverts to ``jax.process_index/count``."""
+    global _rank, _world
+    _rank, _world = rank, world
+
+
+def topology() -> Tuple[int, int]:
+    """Effective (rank, world): the elastic override when set, else the
+    jax.distributed view (single-process: (0, 1))."""
+    if _rank is not None and _world is not None:
+        return _rank, _world
+    return jax.process_index(), jax.process_count()
+
+
+def set_generation(gen: int) -> None:
+    """Record the committed membership generation this process trains in."""
+    global _generation
+    _generation = int(gen)
+
+
+def generation() -> int:
+    return _generation
+
+
+def fence(gen: int) -> None:
+    """Raise unless ``gen`` is the current generation — the guard every
+    gradient contribution passes before leaving this process, so a
+    straggler from a dead epoch can never publish into a live one."""
+    if int(gen) != _generation:
+        raise StaleGenerationError(
+            f"contribution carries generation {gen}, membership is at "
+            f"{_generation}")
 
 
 def _is_initialized() -> bool:
@@ -43,23 +100,37 @@ def _is_initialized() -> bool:
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None):
+               process_id: Optional[int] = None,
+               generation: Optional[int] = None):
     """Initialize the multi-host JAX runtime (idempotent, env-var driven like
-    jax itself: COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID if args omitted).
+    jax itself: COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID if args omitted,
+    with DL4JTPU_RANK/DL4JTPU_WORLD as the elastic cluster's rank wiring).
     Call once per host process before building meshes — and before ANYTHING
     that touches the XLA backend (jax.devices/process_count included), which
-    is why the already-initialized check must not query the backend."""
+    is why the already-initialized check must not query the backend.
+
+    ``generation`` stamps the committed membership generation (see
+    ``fence``); the elastic worker re-initializes it on every reform."""
+    if generation is not None:
+        set_generation(generation)
+    if process_id is None and os.environ.get("DL4JTPU_RANK"):
+        process_id = int(os.environ["DL4JTPU_RANK"])
+    if num_processes is None and os.environ.get("DL4JTPU_WORLD"):
+        num_processes = int(os.environ["DL4JTPU_WORLD"])
     if _is_initialized():
         return
-    kwargs = {}
     if coordinator_address or os.environ.get("COORDINATOR_ADDRESS"):
-        kwargs["coordinator_address"] = (coordinator_address or
-                                         os.environ["COORDINATOR_ADDRESS"])
+        kwargs = {"coordinator_address": (coordinator_address or
+                                          os.environ["COORDINATOR_ADDRESS"])}
         if num_processes is not None:
             kwargs["num_processes"] = num_processes
         if process_id is not None:
             kwargs["process_id"] = process_id
         jax.distributed.initialize(**kwargs)
+    elif process_id is not None and num_processes is not None:
+        # no jax-level cluster (the loopback-TCP fallback): record the
+        # coordinator-assigned topology so local_batch_slice still shards
+        set_topology(process_id, num_processes)
 
 
 def pod_mesh(axes=("data",), shape=None) -> Mesh:
@@ -71,9 +142,17 @@ def pod_mesh(axes=("data",), shape=None) -> Mesh:
     return Mesh(devs, axes)
 
 
-def local_batch_slice(global_batch: int) -> slice:
-    """This host's slice of a globally-sharded batch (data axis split across
-    processes, parity with each Spark executor reading its partition)."""
-    per = global_batch // jax.process_count()
-    i = jax.process_index()
-    return slice(i * per, (i + 1) * per)
+def local_batch_slice(global_batch: int, rank: Optional[int] = None,
+                      world: Optional[int] = None) -> slice:
+    """This process's slice of a globally-sharded batch (data axis split
+    across processes, parity with each Spark executor reading its
+    partition). ``rank``/``world`` override the ambient topology — the
+    elastic cluster passes its committed-generation membership so a
+    degraded N-1 world re-shards without touching jax.distributed. Ragged
+    worlds are handled: the first ``global_batch % world`` ranks take one
+    extra row, so every row is owned exactly once."""
+    if rank is None or world is None:
+        rank, world = topology()
+    base, rem = divmod(int(global_batch), int(world))
+    start = rank * base + min(rank, rem)
+    return slice(start, start + base + (1 if rank < rem else 0))

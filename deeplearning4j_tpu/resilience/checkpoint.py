@@ -80,6 +80,7 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self._entries: List[Checkpoint] = []
         self._save_count = 0
+        self._anchor_iteration: Optional[int] = None
         self._load_manifest()
 
     # -- manifest ----------------------------------------------------------
@@ -218,6 +219,29 @@ class CheckpointManager:
                 self._rotate()
             self._write_manifest()
         return entry
+
+    def set_anchor(self, iteration: int) -> Checkpoint:
+        """Advance the recovery anchor to ``iteration``: pin it, then unpin
+        the previous anchor so only one checkpoint is ever anchor-held.
+        The elastic coordinator calls this after every checkpoint commit —
+        the anchored step is where survivors barrier and replacements
+        restore from, so rotation must never take it, no matter how far
+        training runs ahead."""
+        entry = self.pin(iteration)
+        prev = self._anchor_iteration
+        self._anchor_iteration = int(iteration)
+        if prev is not None and prev != int(iteration):
+            try:
+                self.unpin(prev)
+            except ValueError:
+                pass            # previous anchor already rotated/unknown
+        return entry
+
+    @property
+    def anchor(self) -> Optional[int]:
+        """Iteration of the current recovery anchor (None before the first
+        ``set_anchor``)."""
+        return self._anchor_iteration
 
     def checkpoints(self) -> List[Checkpoint]:
         return list(self._entries)

@@ -23,8 +23,14 @@ tests/test_resilience.py rather than to ship in a training loop:
   black-hole its socket (accept, then forward nothing): connects succeed
   but every request hangs until the client's timeout — the failure mode
   health checks exist for, distinct from connection-refused.
-- ``kill_replica`` — SIGKILL a replica process: the real crash, no drain,
-  no goodbye (the chaos soak's mid-storm kill).
+- ``kill_replica`` / ``kill_worker`` — SIGKILL a replica/training-worker
+  process: the real crash, no drain, no goodbye (the chaos soaks' mid-storm
+  and mid-fit kills).
+- ``WorkerChaos`` — in-worker chaos for elastic training, parsed from the
+  ``DL4JTPU_WORKER_CHAOS`` env var the cluster manager plants: a per-step
+  slowdown (straggler injection) and/or a scripted self-SIGKILL at a given
+  step, so a subprocess worker can die mid-fit without the test needing to
+  time an external kill against a race.
 
 ``SimulatedCrash`` subclasses BaseException on purpose: production code is
 entitled to ``except Exception`` around batches, and a simulated kill must
@@ -44,7 +50,7 @@ from deeplearning4j_tpu.resilience.errors import InjectedFaultError
 
 __all__ = ["SimulatedCrash", "CrashAfter", "FlakyIterator", "FlakyBroker",
            "FlakyEngine", "ServerFaultInjector", "BlackholeProxy",
-           "kill_replica"]
+           "kill_replica", "kill_worker", "WorkerChaos"]
 
 
 class SimulatedCrash(BaseException):
@@ -355,3 +361,56 @@ def kill_replica(proc) -> None:
     ``.pid``): no drain, no atexit, no flushed sockets — the genuine crash
     the failover path must absorb."""
     os.kill(proc.pid, signal.SIGKILL)
+
+
+def kill_worker(proc) -> None:
+    """SIGKILL a training worker (a ``subprocess.Popen``, a
+    ``cluster.WorkerProcess``, or anything with ``.pid``). Same primitive as
+    ``kill_replica``, named for the elastic-training soak: the coordinator
+    must detect the silence via lease expiry — there is no exit hook."""
+    os.kill(int(getattr(proc, "pid")), signal.SIGKILL)
+
+
+class WorkerChaos:
+    """Scripted in-worker chaos for elastic training.
+
+    ``slow_ms``: sleep this long inside every training step (the straggler
+    a lease-based detector must NOT evict while heartbeats keep flowing).
+    ``die_at_step``: the worker SIGKILLs ITSELF when about to execute this
+    step — deterministic mid-fit death with no cross-process kill race.
+
+    Spec string (the ``DL4JTPU_WORKER_CHAOS`` env var the cluster manager
+    plants per worker): comma-separated ``key=value``, e.g.
+    ``"die_at_step=5"`` or ``"slow_ms=200,die_at_step=9"``.
+    """
+
+    def __init__(self, slow_ms: float = 0.0,
+                 die_at_step: Optional[int] = None):
+        self.slow_ms = float(slow_ms)
+        self.die_at_step = None if die_at_step is None else int(die_at_step)
+
+    @classmethod
+    def parse(cls, spec: str) -> "WorkerChaos":
+        kw = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            key = key.strip()
+            if key not in ("slow_ms", "die_at_step"):
+                raise ValueError(f"unknown worker-chaos key {key!r} in "
+                                 f"{spec!r} (want slow_ms/die_at_step)")
+            kw[key] = float(val) if key == "slow_ms" else int(val)
+        return cls(**kw)
+
+    @classmethod
+    def from_env(cls, var: str = "DL4JTPU_WORKER_CHAOS") -> "WorkerChaos":
+        return cls.parse(os.environ.get(var, ""))
+
+    def on_step(self, step: int) -> None:
+        """Call at the top of every training step. May never return."""
+        if self.die_at_step is not None and step >= self.die_at_step:
+            os.kill(os.getpid(), signal.SIGKILL)
+        if self.slow_ms > 0:
+            time.sleep(self.slow_ms / 1000.0)

@@ -6,6 +6,12 @@ initialize()/pod_mesh()/local_batch_slice() and that a psum actually sums
 across process boundaries — the reference's Spark `local[N]`-style
 distributed test, but over real process boundaries (SURVEY.md §4).
 
+Prints two markers so the pytest side can assert formation/sharding
+unconditionally and gate only the collective on backend support:
+
+    WORKER_<pid>_FORMED global=<n> local=<n>     cluster + mesh + slice OK
+    WORKER_<pid>_OK psum=<total|unsupported>     the collective itself
+
 Usage: _dist_worker.py <coordinator_port> <process_id> <num_processes>
 """
 
@@ -37,9 +43,16 @@ def main():
     mesh = distributed.pod_mesh(("data",))
     assert mesh.devices.size == n_global
 
+    # batch sharding: every row owned exactly once, at the offset this
+    # process's rank dictates (ragged worlds are covered by test_elastic)
+    sl = distributed.local_batch_slice(8)
+    assert sl == slice(pid * 4, (pid + 1) * 4), sl
+
+    print(f"WORKER_{pid}_FORMED global={n_global} local={n_local}")
+
     # psum across the full pod: each device contributes (global_index + 1);
     # every process must see the same whole-cluster total.
-    import jax.numpy as jnp
+    import jax.numpy as jnp  # noqa: F401
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax.experimental.shard_map import shard_map
@@ -66,9 +79,6 @@ def main():
         raise
     want = float(vals.sum())
     assert got == want, (got, want)
-
-    sl = distributed.local_batch_slice(8)
-    assert sl == slice(pid * 4, (pid + 1) * 4), sl
 
     print(f"WORKER_{pid}_OK psum={got}")
 

@@ -184,3 +184,19 @@ def test_ladder_row_fast():
     assert row["pad_rows_saved"] > 0
     # the row's vs_baseline IS the pad-waste fraction vs pow2 — must improve
     assert row["vs_baseline"] < 1.0
+
+
+def test_elastic_row_fast():
+    row = bench.bench_elastic(fast=True)
+    # the function itself asserts bitwise digest agreement across the
+    # REAL subprocess members and that every step reduced exactly once;
+    # the SIGKILL-mid-run soak and its recovery wall are full-mode-only
+    # (tests/test_elastic.py's slow soak covers the kill path in CI)
+    assert row["unit"] == "s"
+    assert row["workers"] == 2
+    assert row["kill_at_step"] is None
+    assert row["bitwise_parity"] is True
+    assert row["failed_steps"] == 0
+    assert row["replacements"] == 0
+    assert row["generations"] == 1
+    assert row["scaling_efficiency"] > 0

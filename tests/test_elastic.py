@@ -1,0 +1,306 @@
+"""Elastic cluster tests: coordinator state machine + real subprocess runs.
+
+Two layers, mirroring exec/elastic.py's split:
+
+- **Fake-clock matrix** — the ``ElasticCoordinator`` is pure logic with an
+  injectable clock, so the whole lease walk (heartbeat miss → suspect →
+  evict → rejoin), stale-generation fencing and N-1 degradation run with
+  zero sleeps and zero processes.
+- **Subprocess runs** — ``ClusterManager`` spawns real
+  ``python -m deeplearning4j_tpu.exec.worker`` processes. The fast N=2
+  smoke stays in tier-1; the N=4 SIGKILL soak (bitwise kill-and-rejoin
+  parity, zero job restarts) and the partition test are ``slow``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.exec.elastic import (ClusterFullError,
+                                             ElasticCoordinator,
+                                             EvictedError, FencedError,
+                                             LIVE, SUSPECT)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+def _form(n: int, clock: FakeClock = None, **kw):
+    """Join + sync n workers through formation; returns (coord, clock)."""
+    clock = clock or FakeClock()
+    coord = ElasticCoordinator(n, clock=clock, **kw)
+    for i in range(n):
+        coord.join(f"w{i}")
+        clock.advance(0.01)     # distinct joined_at → deterministic ranks
+    for i in range(n):
+        coord.sync(f"w{i}", 1)
+    assert coord.generation == 1 and coord.proposal is None
+    assert coord.world == n
+    return coord, clock
+
+
+def _ranks(coord):
+    return {wid: m["rank"] for wid, m in coord.state()["members"].items()}
+
+
+# ---------------------------------------------------------------------------
+# fake-clock state machine
+# ---------------------------------------------------------------------------
+
+def test_formation_commits_generation_one_with_dense_ranks():
+    coord, _ = _form(3)
+    assert coord.phase == "running"
+    assert sorted(_ranks(coord).values()) == [0, 1, 2]
+
+
+def test_sync_waits_until_every_member_acks():
+    clock = FakeClock()
+    coord = ElasticCoordinator(2, clock=clock)
+    coord.join("w0")
+    assert coord.sync("w0", 1) == {"status": "wait", "proposal": 1}
+    coord.join("w1")
+    assert coord.sync("w0", 1)["status"] == "wait"   # w1 not acked yet
+    view = None
+    coord.sync("w1", 1)
+    view = coord.sync("w0", 1)
+    assert view["status"] == "go" and view["generation"] == 1
+    assert view["world"] == 2
+
+
+def test_join_beyond_world_size_rejected():
+    coord, _ = _form(2)
+    with pytest.raises(ClusterFullError):
+        coord.join("w2")
+
+
+def test_heartbeat_from_non_member_raises_evicted():
+    coord, _ = _form(1)
+    with pytest.raises(EvictedError):
+        coord.heartbeat("ghost", generation=1)
+
+
+def test_missed_heartbeats_walk_live_suspect_and_heal():
+    coord, clock = _form(2, suspect_after=1.5, evict_after=4.0)
+    clock.advance(1.0)
+    coord.heartbeat("w0", generation=1)
+    clock.advance(0.7)                   # w1 lease age ~1.7 >= 1.5
+    coord.tick()
+    states = {w: m["state"] for w, m in coord.state()["members"].items()}
+    assert states["w1"] == SUSPECT and states["w0"] == LIVE
+    coord.heartbeat("w1", generation=1)  # a heartbeat heals suspicion
+    states = {w: m["state"] for w, m in coord.state()["members"].items()}
+    assert states["w1"] == LIVE
+
+
+def test_lease_expiry_evicts_and_replacement_recommits_full_world():
+    coord, clock = _form(2, suspect_after=1.5, evict_after=4.0,
+                         replacement_grace=8.0)
+    ranks_before = _ranks(coord)
+    clock.advance(2.0)
+    coord.heartbeat("w0", generation=1)
+    clock.advance(2.0)                   # w1 lease age 4.0 → evicted
+    coord.tick()
+    assert "w1" not in coord.state()["members"]
+    assert coord.proposal == 2           # reform in flight
+    evs = [e["type"] for e in coord.events]
+    assert "evicted" in evs and "reform_proposed" in evs
+
+    # mid-reform heartbeats carry the rollback directive
+    assert coord.heartbeat("w0", generation=1)["directive"] == "rollback"
+
+    joined = coord.join("w1b")           # the supervisor's replacement
+    assert joined["proposal"] == 2
+    coord.sync("w0", 2)
+    assert coord.generation == 1         # replacement not synced yet
+    coord.sync("w1b", 2)
+    assert coord.generation == 2 and coord.world == 2
+    # survivor keeps its rank; the replacement fills the hole — the shard
+    # mapping matches an unkilled run (what bitwise parity depends on)
+    ranks = _ranks(coord)
+    assert ranks["w0"] == ranks_before["w0"]
+    assert ranks["w1b"] == ranks_before["w1"]
+    assert coord.last_recovery_wall == pytest.approx(
+        clock.t - (100.0 + 0.02 + 4.0), abs=1e-6)
+    assert coord.heartbeat("w0", generation=2)["directive"] == "none"
+
+
+def test_stale_generation_contribution_is_fenced():
+    coord, clock = _form(2)
+    coord.leave("w1")                    # opens proposal 2
+    with pytest.raises(FencedError) as ei:
+        coord.contribute("w0", generation=1, step=3, rows=16,
+                         vec=np.zeros(4, np.float32))
+    assert ei.value.proposal == 2
+    # after the reform commits, a straggler stamped gen 1 is still fenced
+    clock.advance(coord.replacement_grace + 0.1)
+    coord.sync("w0", 2)
+    coord.tick()
+    assert coord.generation == 2
+    with pytest.raises(FencedError):
+        coord.contribute("w0", generation=1, step=3, rows=16,
+                         vec=np.zeros(4, np.float32))
+
+
+def test_grace_expiry_commits_degraded_n_minus_1():
+    coord, clock = _form(3, replacement_grace=5.0)
+    coord.leave("w2")
+    coord.sync("w0", 2)
+    coord.sync("w1", 2)
+    assert coord.generation == 1         # grace window still open
+    clock.advance(2.6)                   # survivors keep their leases warm
+    coord.heartbeat("w0", generation=1)
+    coord.heartbeat("w1", generation=1)
+    clock.advance(2.6)
+    coord.tick()
+    assert coord.generation == 2 and coord.world == 2
+    assert sorted(_ranks(coord).values()) == [0, 1]   # ranks compacted
+    committed = [e for e in coord.events
+                 if e["type"] == "generation_committed" and e["world"] == 2]
+    assert committed, coord.events
+
+
+def test_allreduce_rank_order_deterministic_and_idempotent():
+    coord, _ = _form(2)
+    v0 = np.array([2.0, 4.0], np.float32)     # pre-scaled by rows
+    v1 = np.array([6.0, 8.0], np.float32)
+    coord.contribute("w0", generation=1, step=0, rows=2, vec=v0)
+    coord.contribute("w1", generation=1, step=0, rows=2, vec=v1)
+    got = coord.wait_reduced("w0", generation=1, step=0, timeout=1.0)
+    np.testing.assert_array_equal(got, np.array([2.0, 3.0], np.float32))
+    # a retried POST after the reduction is a no-op, same answer served
+    coord.contribute("w0", generation=1, step=0, rows=2, vec=v0)
+    again = coord.wait_reduced("w1", generation=1, step=0, timeout=1.0)
+    np.testing.assert_array_equal(again, got)
+    assert coord.reduced_steps == 1
+
+
+def test_wait_reduced_fenced_when_membership_changes_mid_barrier():
+    coord, _ = _form(2)
+    coord.contribute("w0", generation=1, step=0, rows=2,
+                     vec=np.zeros(2, np.float32))
+    coord.leave("w1")                    # barrier can never complete
+    with pytest.raises(FencedError):
+        coord.wait_reduced("w0", generation=1, step=0, timeout=1.0)
+
+
+def test_rank_tagged_spill_paths(monkeypatch):
+    from deeplearning4j_tpu.monitor.flight import rank_tagged_path
+    monkeypatch.delenv("DL4JTPU_RANK", raising=False)
+    assert rank_tagged_path("/tmp/x/spill.json") == "/tmp/x/spill.json"
+    monkeypatch.setenv("DL4JTPU_RANK", "2")
+    assert rank_tagged_path("/tmp/x/spill.json") == "/tmp/x/spill.rank2.json"
+    assert rank_tagged_path("/tmp/x/spill.rank2.json") \
+        == "/tmp/x/spill.rank2.json"
+
+
+# ---------------------------------------------------------------------------
+# real subprocess clusters
+# ---------------------------------------------------------------------------
+
+def _digests(res):
+    return {w: r["params_digest"] for w, r in res["results"].items()}
+
+
+def test_cluster_n2_smoke_parity_with_single_process(tmp_path):
+    from deeplearning4j_tpu.exec.cluster import ClusterManager
+    res2 = ClusterManager(tmp_path / "n2", workers=2, total_steps=6,
+                          global_batch=32, ckpt_every=3,
+                          aot=True).run(timeout=180)
+    d2 = _digests(res2)
+    assert len(d2) == 2 and len(set(d2.values())) == 1, d2
+    assert res2["reduced_steps"] == 6
+    assert res2["spawns"] == 2 and res2["replacements"] == 0
+    assert res2["generation"] == 1       # membership never changed
+    assert res2["checkpoint"] is not None
+
+    # same job, world of one: the loss trajectory must agree (tolerance,
+    # not bitwise — the rank-ordered sum associates floats differently)
+    res1 = ClusterManager(tmp_path / "n1", workers=1, total_steps=6,
+                          global_batch=32, ckpt_every=3,
+                          aot=False).run(timeout=180)
+    (l1,) = [r["final_loss"] for r in res1["results"].values()]
+    (l2,) = {r["final_loss"] for r in res2["results"].values()}
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert l2 == pytest.approx(l1, rel=1e-3), (l1, l2)
+
+
+@pytest.mark.slow
+def test_sigkill_and_rejoin_is_bitwise_and_restarts_nothing(tmp_path):
+    """The headline soak: N=4, worker 2 SIGKILLs itself mid-run, the
+    replacement restores checkpoint + AOT and the final params are
+    bitwise identical to an unkilled N=4 run — with zero job restarts."""
+    from deeplearning4j_tpu.exec.cluster import ClusterManager
+    ref = ClusterManager(tmp_path / "ref", workers=4, total_steps=10,
+                         global_batch=32, ckpt_every=4,
+                         aot=True).run(timeout=240)
+    dr = _digests(ref)
+    assert len(set(dr.values())) == 1, dr
+
+    mgr = ClusterManager(tmp_path / "kill", workers=4, total_steps=10,
+                         global_batch=32, ckpt_every=4, aot=True,
+                         chaos={2: "die_at_step=6"})
+    res = mgr.run(timeout=240)
+    dk = _digests(res)
+    assert len(set(dk.values())) == 1, dk
+    assert set(dk.values()) == set(dr.values()), (dr, dk)   # bitwise parity
+
+    # exactly one replacement joined the SAME job — nothing restarted
+    assert res["replacements"] == 1 and res["spawns"] == 5
+    assert res["generation"] == 2
+    assert "w2r1" in res["results"]
+    assert res["results"]["w2r1"]["rejoined"]
+    assert res["results"]["w2r1"]["aot_restored"] >= 1
+    for wid in ("w0", "w1", "w3"):       # survivors ran straight through
+        assert mgr.procs[wid].proc.returncode == 0, wid
+    assert res["last_recovery_wall"] is not None
+    assert 0 < res["last_recovery_wall"] < 60
+    evs = [e["type"] for e in res["events"]]
+    assert "evicted" in evs and "generation_committed" in evs
+
+
+@pytest.mark.slow
+def test_partition_evicts_and_cluster_continues_degraded(tmp_path):
+    """Blackholed coordinator link: the worker process stays alive but its
+    heartbeats vanish — lease expiry evicts it and, with no replacement,
+    the grace window expires into an N-1 degraded commit that finishes
+    the job."""
+    from deeplearning4j_tpu.exec.cluster import ClusterManager
+    mgr = ClusterManager(tmp_path / "part", workers=3, total_steps=10,
+                         global_batch=30, ckpt_every=3, aot=False,
+                         hb_interval=0.2, suspect_after=0.8,
+                         evict_after=2.0, replacement_grace=2.0,
+                         replace=False, partition=[2])
+    mgr.start()
+    try:
+        deadline = time.monotonic() + 120
+        while mgr.coord.reduced_steps < 4:   # train past the first anchor
+            if time.monotonic() > deadline:
+                raise TimeoutError("cluster never reached step 4")
+            time.sleep(0.05)
+        assert mgr.procs["w2"].alive()
+        mgr.partition_worker("w2")
+    except BaseException:
+        mgr.stop()
+        raise
+    res = mgr.run(timeout=180)
+
+    assert res["world"] == 2             # finished degraded, no replacement
+    assert set(res["results"]) == {"w0", "w1"}
+    digs = {r["params_digest"] for r in res["results"].values()}
+    assert len(digs) == 1, res["results"]
+    evicted = [e for e in res["events"] if e["type"] == "evicted"]
+    assert evicted and evicted[0]["worker_id"] == "w2"
+    assert evicted[0]["reason"] == "lease_expired"
+    degraded = [e for e in res["events"]
+                if e["type"] == "generation_committed" and e["world"] == 2]
+    assert degraded, res["events"]
+    assert res["reduced_steps"] == 10

@@ -1,0 +1,104 @@
+"""Launch an elastic N-process training cluster from the shell.
+
+The operator entry for exec/cluster.py (docs/ELASTIC_TRAINING.md): spins
+up the coordinator plus N subprocess workers, supervises them (evicted
+seats are auto-replaced), and prints the run summary as JSON. Chaos is
+injectable per seat for drills:
+
+    JAX_PLATFORMS=cpu python tools/launch_cluster.py \
+        --workers 4 --steps 16 --chaos 2=die_at_step=8
+
+    # partition drill: seat 1's coordinator link through a blackhole-able
+    # proxy, starved after the first checkpoint anchor lands
+    python tools/launch_cluster.py --workers 3 --partition 1 --no-replace
+
+Exit code 0 when the job finishes (including degraded N-1 finishes),
+1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _parse_chaos(specs):
+    """["2=die_at_step=8", "0=slow_ms=50"] → {2: "die_at_step=8", ...}."""
+    out = {}
+    for spec in specs or ():
+        seat, _, rest = spec.partition("=")
+        if not rest:
+            raise SystemExit(f"--chaos wants SEAT=SPEC, got {spec!r}")
+        from deeplearning4j_tpu.resilience.faults import WorkerChaos
+        WorkerChaos.parse(rest)         # validate eagerly, fail before spawn
+        out[int(seat)] = rest
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run an elastic N-process training cluster")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--devices-per-worker", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--global-batch", type=int, default=32)
+    ap.add_argument("--model", default="mlp")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--ckpt-every", type=int, default=4)
+    ap.add_argument("--no-aot", action="store_true",
+                    help="skip the AOT companion on checkpoint anchors")
+    ap.add_argument("--workdir", default=None,
+                    help="checkpoints + worker logs land here "
+                         "(default: a fresh temp dir, kept)")
+    ap.add_argument("--chaos", nargs="*", metavar="SEAT=SPEC",
+                    help="per-seat fault spec, e.g. 2=die_at_step=8 or "
+                         "1=slow_ms=200 (resilience.faults.WorkerChaos)")
+    ap.add_argument("--partition", nargs="*", type=int, metavar="SEAT",
+                    help="route these seats through a blackhole-able proxy "
+                         "and starve the link once training is underway")
+    ap.add_argument("--no-replace", action="store_true",
+                    help="let evictions degrade the world instead of "
+                         "spawning replacements")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    a = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.exec.cluster import ClusterManager
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="dl4jtpu_cluster_")
+    mgr = ClusterManager(
+        workdir, a.workers, devices_per_worker=a.devices_per_worker,
+        total_steps=a.steps, global_batch=a.global_batch, model=a.model,
+        seed=a.seed, ckpt_every=a.ckpt_every, aot=not a.no_aot,
+        replace=not a.no_replace, chaos=_parse_chaos(a.chaos),
+        partition=a.partition)
+    print(f"coordinator up; workdir={workdir}", file=sys.stderr)
+    mgr.start()
+    try:
+        if a.partition:
+            # drill choreography: let the job anchor a checkpoint, then
+            # starve every proxied link and watch the lease detector work
+            while mgr.coord.reduced_steps < a.ckpt_every:
+                time.sleep(0.1)
+            for seat in a.partition:
+                print(f"partitioning w{seat}", file=sys.stderr)
+                mgr.partition_worker(f"w{seat}")
+        res = mgr.run(timeout=a.timeout)
+    except Exception as e:  # noqa: BLE001 — CLI: report, nonzero exit
+        mgr.stop()
+        print(f"cluster failed: {e}", file=sys.stderr)
+        return 1
+    digests = {w: r["params_digest"] for w, r in res["results"].items()}
+    res["bitwise_agreement"] = len(set(digests.values())) == 1
+    res["workdir"] = workdir
+    print(json.dumps(res, indent=1, default=str))
+    return 0 if res["bitwise_agreement"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
