@@ -89,12 +89,15 @@ class WorkerProcess:
         if self.extra_env:
             env.update(self.extra_env)
         # log to a FILE: a full stdout pipe would deadlock a worker nobody
-        # reads, and the post-mortem wants the log anyway
-        self._logf = open(self._log, "ab")
+        # reads, and the post-mortem wants the log anyway. The child owns
+        # its inherited fd after the spawn, so the parent's handle closes
+        # immediately — replacements must not leak descriptors in the
+        # supervisor for the life of the run.
         self.spawned_at = time.monotonic()
-        self.proc = subprocess.Popen(cmd, stdout=self._logf,
-                                     stderr=subprocess.STDOUT, env=env,
-                                     cwd=self.workdir)
+        with open(self._log, "ab") as logf:
+            self.proc = subprocess.Popen(cmd, stdout=logf,
+                                         stderr=subprocess.STDOUT, env=env,
+                                         cwd=self.workdir)
         return self
 
     def wait_joined(self, timeout: float = 120.0) -> "WorkerProcess":
@@ -228,8 +231,11 @@ class ClusterManager:
         with self.coord._lock:
             events = self.coord.events[self._events_seen:]
             self._events_seen += len(events)
+            done = self.coord.phase == "done"
         for ev in events:
-            if ev["type"] != "evicted" or not self.replace:
+            # a finished job needs no replacement — the eviction that
+            # completed it (last non-reporter died) must not spawn one
+            if done or ev["type"] != "evicted" or not self.replace:
                 continue
             if self.replacements >= self.max_replacements:
                 continue

@@ -385,11 +385,16 @@ class ElasticCoordinator:
             self._evict_t = now
         self.events.append({"type": "evicted", "worker_id": worker_id,
                             "rank": m.rank, "reason": reason, "t": now})
-        if self._members:
-            self._propose(now, reason=f"evict:{worker_id}", evicted=True)
-        else:
-            self.proposal = self.generation + 1
-            self._grace_deadline = None
+        # the dead member may have been the only one yet to post a result;
+        # without this re-check the finished survivors would wait in a
+        # reform nobody can commit and the job would never reach "done"
+        self._maybe_done()
+        if self.phase != "done":
+            if self._members:
+                self._propose(now, reason=f"evict:{worker_id}", evicted=True)
+            else:
+                self.proposal = self.generation + 1
+                self._grace_deadline = None
         self._publish_gauges()
         self._cond.notify_all()
 
@@ -478,10 +483,16 @@ class ElasticCoordinator:
     def result(self, worker_id: str, payload: dict) -> None:
         with self._lock:
             self._results[worker_id] = dict(payload)
-            live = set(self._members)
-            if live and live <= set(self._results):
-                self.phase = "done"
-                self._cond.notify_all()
+            self._maybe_done()
+
+    def _maybe_done(self):
+        """Every live member has posted its result → the job is done.
+        Checked after results AND after evictions, because either event
+        can be the one that completes the condition."""
+        live = set(self._members)
+        if live and live <= set(self._results):
+            self.phase = "done"
+            self._cond.notify_all()
 
     def results(self) -> Dict[str, dict]:
         with self._lock:

@@ -324,10 +324,21 @@ class ElasticWorker:
             from deeplearning4j_tpu.util.model_serializer import restore_into
             restore_into(self.net, path)
             self._maybe_restore_aot(path)
-        else:
-            # no anchor yet: formation at step 0 on the deterministic
-            # seed-built model — identical across members by construction
-            self.net.iteration = 0
+            return
+        # no anchor yet: restart step 0 on the deterministic seed-built
+        # model. A survivor rolling back here (eviction before the first
+        # checkpoint) has already applied updates, so resetting the step
+        # counter alone would replay steps 0..k onto advanced params while
+        # a replacement starts from the fresh seed build — rebuild from
+        # seed so every member re-enters step 0 bitwise identical.
+        if self.net is not None and self.net.iteration != 0:
+            from deeplearning4j_tpu.serving.replica import build_model
+            self.net = build_model(self.cfg["model"])
+            self._grad_exec.clear()
+            self._upd_exec = None
+            self._unravel = None
+            self._build_programs()
+        self.net.iteration = 0
 
     # -- programs ----------------------------------------------------------
     def _build_programs(self) -> None:

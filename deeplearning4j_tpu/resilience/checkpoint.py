@@ -101,6 +101,8 @@ class CheckpointManager:
             self._recover_from_scan()
             return
         self._save_count = int(doc.get("save_count", 0))
+        anchor = doc.get("anchor_iteration")
+        self._anchor_iteration = int(anchor) if anchor is not None else None
         self._entries = [
             Checkpoint(filename=e["filename"], iteration=int(e["iteration"]),
                        epoch=int(e["epoch"]),
@@ -126,6 +128,7 @@ class CheckpointManager:
     def _write_manifest(self):
         doc = {"format": "deeplearning4j_tpu/checkpoint-manifest/v1",
                "save_count": self._save_count,
+               "anchor_iteration": self._anchor_iteration,
                "checkpoints": [
                    {"filename": c.filename, "iteration": c.iteration,
                     "epoch": c.epoch, "epoch_batch": c.epoch_batch,
@@ -226,15 +229,23 @@ class CheckpointManager:
         The elastic coordinator calls this after every checkpoint commit —
         the anchored step is where survivors barrier and replacements
         restore from, so rotation must never take it, no matter how far
-        training runs ahead."""
-        entry = self.pin(iteration)
+        training runs ahead. The anchor persists in the manifest, so a
+        replacement rank 0 opening the same directory unpins its dead
+        predecessor's anchor instead of leaking the pin forever."""
+        iteration = int(iteration)
         prev = self._anchor_iteration
-        self._anchor_iteration = int(iteration)
-        if prev is not None and prev != int(iteration):
+        # set before pin: pin's manifest write must carry the new anchor
+        self._anchor_iteration = iteration
+        entry = self.pin(iteration)
+        if prev is not None and prev != iteration:
             try:
                 self.unpin(prev)
             except ValueError:
                 pass            # previous anchor already rotated/unknown
+        if prev != iteration:
+            # pin/unpin skip writing when the flag did not flip (e.g. the
+            # entry was already pinned); the moved anchor must still land
+            self._write_manifest()
         return entry
 
     @property

@@ -168,6 +168,48 @@ def test_grace_expiry_commits_degraded_n_minus_1():
     assert committed, coord.events
 
 
+def test_eviction_of_last_nonreporter_completes_the_job():
+    """If the only member that has NOT posted a result dies, the eviction
+    itself must complete the job — there is no later result() call to
+    re-check the condition, and the finished survivors would otherwise
+    wait forever in a reform nobody can commit."""
+    coord, clock = _form(2, suspect_after=1.5, evict_after=4.0)
+    coord.result("w0", {"final_loss": 0.5})
+    assert coord.phase == "running"      # w1 still training
+    clock.advance(2.0)
+    coord.heartbeat("w0", generation=1)  # survivor's lease stays warm
+    clock.advance(2.0)                   # w1 lease age 4.0 → evicted
+    coord.tick()
+    assert "w1" not in coord.state()["members"]
+    assert coord.phase == "done"
+    assert coord.proposal is None        # no reform holds the finished job
+
+
+def test_rollback_without_anchor_rebuilds_the_seed_model():
+    """A survivor fenced BEFORE the first checkpoint has already applied
+    updates; its rollback must rebuild the deterministic seed model, not
+    just reset the step counter — otherwise it replays steps 0..k onto
+    advanced params while a replacement starts from the fresh build, and
+    the members diverge forever."""
+    import jax
+
+    from deeplearning4j_tpu.exec.worker import ElasticWorker, params_digest
+    from deeplearning4j_tpu.serving.replica import build_model
+
+    w = ElasticWorker("http://127.0.0.1:9", "wX")   # never dials out
+    w.cfg = {"model": "mlp"}
+    w.net = build_model("mlp")
+    w._build_programs()
+    seed_digest = params_digest(w.net.params)
+    # pretend two steps applied before the eviction reached us
+    w.net.params = jax.tree_util.tree_map(lambda a: a + 1.0, w.net.params)
+    w.net.iteration = 2
+    w.anchor = {"step": 0, "path": None}
+    w._restore_anchor()
+    assert w.net.iteration == 0
+    assert params_digest(w.net.params) == seed_digest
+
+
 def test_allreduce_rank_order_deterministic_and_idempotent():
     coord, _ = _form(2)
     v0 = np.array([2.0, 4.0], np.float32)     # pre-scaled by rows
@@ -265,6 +307,30 @@ def test_sigkill_and_rejoin_is_bitwise_and_restarts_nothing(tmp_path):
     assert 0 < res["last_recovery_wall"] < 60
     evs = [e["type"] for e in res["events"]]
     assert "evicted" in evs and "generation_committed" in evs
+
+
+@pytest.mark.slow
+def test_kill_before_first_checkpoint_recovers_bitwise(tmp_path):
+    """Worker death BEFORE any anchor exists: the rollback has no
+    checkpoint to restore, so survivors rebuild the seed model and the
+    whole cluster replays from step 0 — final params bitwise equal to an
+    unkilled run."""
+    from deeplearning4j_tpu.exec.cluster import ClusterManager
+    ref = ClusterManager(tmp_path / "ref", workers=2, total_steps=6,
+                         global_batch=32, ckpt_every=4,
+                         aot=False).run(timeout=240)
+    dr = _digests(ref)
+    assert len(set(dr.values())) == 1, dr
+
+    mgr = ClusterManager(tmp_path / "kill", workers=2, total_steps=6,
+                         global_batch=32, ckpt_every=4, aot=False,
+                         chaos={1: "die_at_step=1"})
+    res = mgr.run(timeout=240)
+    dk = _digests(res)
+    assert len(set(dk.values())) == 1, dk
+    assert set(dk.values()) == set(dr.values()), (dr, dk)   # bitwise parity
+    assert res["replacements"] == 1 and res["spawns"] == 3
+    assert res["reduced_steps"] == 6
 
 
 @pytest.mark.slow

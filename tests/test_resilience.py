@@ -157,6 +157,27 @@ def test_keep_last_rotation_and_keep_every_pinning(tmp_path):
         "checkpoint_iter0000000007_epoch0000.zip")
 
 
+def test_anchor_pin_survives_manager_restart(tmp_path):
+    net = build_net()
+    mgr = CheckpointManager(tmp_path, keep_last=2)
+    for i in (4, 8):
+        net.iteration = i
+        mgr.save(net)
+        mgr.set_anchor(i)
+    assert mgr.anchor == 8
+    # advancing the anchor releases the previous pin
+    assert [c.iteration for c in mgr.checkpoints() if c.pinned] == [8]
+    # a replacement rank 0 opens the same directory with a FRESH manager;
+    # the anchor persisted in the manifest, so advancing it must unpin the
+    # dead predecessor's anchor instead of leaking the pin forever
+    fresh = CheckpointManager(tmp_path, keep_last=2)
+    assert fresh.anchor == 8
+    net.iteration = 12
+    fresh.save(net)
+    fresh.set_anchor(12)
+    assert [c.iteration for c in fresh.checkpoints() if c.pinned] == [12]
+
+
 def test_manager_recovers_from_damaged_manifest(tmp_path):
     net = build_net()
     mgr = CheckpointManager(tmp_path, keep_last=5)
