@@ -168,7 +168,11 @@ class ClusterManager:
                  evict_after: float = 4.0, replacement_grace: float = 8.0,
                  replace: bool = True, max_replacements: int = 4,
                  chaos: Optional[Dict[int, str]] = None,
-                 partition: Optional[List[int]] = None):
+                 partition: Optional[List[int]] = None,
+                 data_plane: str = "chain", codec: str = "dense",
+                 bucket_mb: float = 4.0, threshold: float = 1e-3,
+                 min_threshold: float = 1e-5, threshold_step: float = 1e-5,
+                 capacity_fraction: float = 0.1):
         self.workdir = os.fspath(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         self.workers = int(workers)
@@ -182,7 +186,10 @@ class ClusterManager:
             model=model, seed=seed, ckpt_dir=self.ckpt_dir,
             ckpt_every=ckpt_every, aot=aot, hb_interval=hb_interval,
             suspect_after=suspect_after, evict_after=evict_after,
-            replacement_grace=replacement_grace)
+            replacement_grace=replacement_grace, data_plane=data_plane,
+            codec=codec, bucket_mb=bucket_mb, threshold=threshold,
+            min_threshold=min_threshold, threshold_step=threshold_step,
+            capacity_fraction=capacity_fraction)
         self.server = CoordinatorServer(self.coord,
                                         tick_interval=hb_interval / 2)
         self.procs: Dict[str, WorkerProcess] = {}

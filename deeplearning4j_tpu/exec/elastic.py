@@ -24,13 +24,18 @@ owns the membership truth:
   ``replacement_grace`` seconds for a replacement; if none joins, it
   commits the new generation at N-1 (ranks compacted, batch re-sharded)
   — throughput drops, correctness doesn't. A later join re-forms at N.
-- **Deterministic loopback-TCP allreduce.** The jaxlib CPU backend ships
-  no cross-process collectives, so the coordinator doubles as the
-  reducer: each member posts ``loss‖grads`` as one f32 vector pre-scaled
-  by its shard rows; the coordinator sums in rank order (fixed float
-  association → bitwise reproducible) and divides by the total rows.
-  On jaxlibs with real collectives the worker's ``DL4JTPU_CLUSTER_BACKEND
-  =jax`` probe switches the reduction to an in-mesh psum instead.
+- **Control plane only (by default).** Gradient bytes travel the
+  peer-to-peer chunk-pipelined chain (``exec/comms.py``,
+  ``data_plane="chain"``): the coordinator hands each committed
+  generation's rank → (host, port) endpoint map to the members and never
+  sees a gradient. The PR 19 star reducer is kept behind
+  ``data_plane="star"`` as the parity oracle and bench baseline: each
+  member posts ``loss‖grads`` pre-scaled by its shard rows; the
+  coordinator sums in rank order (fixed float association → bitwise
+  reproducible) and divides by the total rows — the exact arithmetic the
+  chain reproduces hop by hop. On jaxlibs with real collectives the
+  worker's ``DL4JTPU_CLUSTER_BACKEND=jax`` probe switches to an in-mesh
+  psum instead.
 
 ``CoordinatorServer`` wraps the state machine in the same stdlib
 ThreadingHTTPServer transport the serving tier uses; workers talk to it
@@ -89,6 +94,7 @@ class Member:
     rank: Optional[int] = None          # assigned at generation commit
     synced_gen: int = 0                 # highest proposal this member ack'd
     steps_done: int = 0
+    data_port: int = 0                  # peer data-plane listener (comms.py)
 
 
 @dataclass
@@ -115,9 +121,18 @@ class ElasticCoordinator:
                  aot: bool = True,
                  hb_interval: float = 0.25, suspect_after: float = 1.5,
                  evict_after: float = 4.0, replacement_grace: float = 8.0,
+                 data_plane: str = "chain", codec: str = "dense",
+                 bucket_mb: float = 4.0, threshold: float = 1e-3,
+                 min_threshold: float = 1e-5, threshold_step: float = 1e-5,
+                 capacity_fraction: float = 0.1,
                  clock=time.monotonic):
         if world_size < 1:
             raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if data_plane not in ("chain", "star"):
+            raise ValueError(f"data_plane must be chain|star, "
+                             f"got {data_plane!r}")
+        if codec not in ("dense", "threshold"):
+            raise ValueError(f"codec must be dense|threshold, got {codec!r}")
         self.target_world = int(world_size)
         self.total_steps = int(total_steps)
         self.global_batch = int(global_batch)
@@ -130,6 +145,13 @@ class ElasticCoordinator:
         self.suspect_after = float(suspect_after)
         self.evict_after = float(evict_after)
         self.replacement_grace = float(replacement_grace)
+        self.data_plane = data_plane
+        self.codec = codec
+        self.bucket_mb = float(bucket_mb)
+        self.threshold = float(threshold)
+        self.min_threshold = float(min_threshold)
+        self.threshold_step = float(threshold_step)
+        self.capacity_fraction = float(capacity_fraction)
         self._clock = clock
 
         self.generation = 0                 # last COMMITTED generation
@@ -215,12 +237,22 @@ class ElasticCoordinator:
                 "aot": self.aot,
                 "hb_interval": self.hb_interval,
                 "suspect_after": self.suspect_after,
-                "evict_after": self.evict_after}
+                "evict_after": self.evict_after,
+                "replacement_grace": self.replacement_grace,
+                "data_plane": self.data_plane, "codec": self.codec,
+                "bucket_mb": self.bucket_mb,
+                "threshold": self.threshold,
+                "min_threshold": self.min_threshold,
+                "threshold_step": self.threshold_step,
+                "capacity_fraction": self.capacity_fraction}
 
-    def join(self, worker_id: str) -> dict:
+    def join(self, worker_id: str, data_port: int = 0) -> dict:
         """Register a worker. Initial joins assemble generation 1; any
         join after that (replacement / healed partition) counts as a
-        rejoin and proposes a new generation everyone must sync to."""
+        rejoin and proposes a new generation everyone must sync to.
+        ``data_port`` is the worker's peer data-plane listener — published
+        to every member in the committed membership view so the chain can
+        dial rank-adjacent neighbors directly."""
         with self._lock:
             now = self._clock()
             if (worker_id not in self._members
@@ -230,7 +262,8 @@ class ElasticCoordinator:
                     f"(target {self.target_world})")
             rejoin = self.generation > 0
             self._members[worker_id] = Member(worker_id=worker_id,
-                                              joined_at=now, last_hb=now)
+                                              joined_at=now, last_hb=now,
+                                              data_port=int(data_port))
             self._joins += 1
             if rejoin:
                 self._c_rejoin.inc()
@@ -268,7 +301,10 @@ class ElasticCoordinator:
         m = self._members[worker_id]
         return {"status": "go", "generation": self.generation,
                 "rank": m.rank, "world": self.world,
-                "anchor": dict(self.anchor), "phase": self.phase}
+                "anchor": dict(self.anchor), "phase": self.phase,
+                "endpoints": {str(o.rank): ["127.0.0.1", o.data_port]
+                              for o in self._members.values()
+                              if o.rank is not None}}
 
     def _propose(self, now: float, reason: str, evicted: bool = False):
         """Open (or refresh) a reform: next generation, members must
@@ -348,6 +384,7 @@ class ElasticCoordinator:
             if m.state == SUSPECT:
                 m.state = LIVE          # a heartbeat heals suspicion
             m.steps_done = max(m.steps_done, int(step))
+            self._advance_reduced()
             self._c_hb.inc()
             self._publish_gauges()
             directive = "none"
@@ -480,9 +517,29 @@ class ElasticCoordinator:
                                 "path": path, "t": self._clock()})
             return dict(self.anchor)
 
+    def _advance_reduced(self) -> None:
+        """On the peer-to-peer data plane the coordinator never sees a
+        gradient, so reduced progress is inferred from reported steps: the
+        chain is lockstep — a member can only be at step s+1 once step s
+        reduced across everyone — so min(steps_done) is the fully-reduced
+        floor. Monotone (max) because members report anchor-rolled-back
+        steps during reforms. The star path still advances the counter
+        directly at each barrier; this floor can never outrun it."""
+        if not self._members:
+            return
+        floor = min(m.steps_done for m in self._members.values())
+        if floor > self.reduced_steps:
+            self._c_steps.inc(floor - self.reduced_steps)
+            self.reduced_steps = floor
+
     def result(self, worker_id: str, payload: dict) -> None:
         with self._lock:
             self._results[worker_id] = dict(payload)
+            m = self._members.get(worker_id)
+            if m is not None:
+                m.steps_done = max(m.steps_done,
+                                   int(payload.get("steps") or 0))
+                self._advance_reduced()
             self._maybe_done()
 
     def _maybe_done(self):
@@ -570,7 +627,9 @@ def _mk_handler(coord: ElasticCoordinator):
                     return
                 doc = json.loads(self._read_body() or b"{}")
                 if path == "/join":
-                    self._json(200, coord.join(doc["worker_id"]))
+                    self._json(200, coord.join(
+                        doc["worker_id"],
+                        int(doc.get("data_port", 0) or 0)))
                 elif path == "/sync":
                     self._json(200, coord.sync(doc["worker_id"],
                                                int(doc["generation"])))

@@ -10,14 +10,17 @@ steps until ``total_steps``:
   (``parallel.distributed.local_batch_slice``) — re-sharding after an
   elastic reform is just a different slice of the same bytes.
 - **Reduction.** Grad + loss ravel into one f32 vector, pre-scaled by the
-  shard's row count; the coordinator sums contributions in rank order and
-  divides by the total rows (``docs/ELASTIC_TRAINING.md``). With
-  ``DL4JTPU_CLUSTER_BACKEND=jax`` (and a jaxlib whose backend actually
-  ships cross-process collectives) the same vector goes through a real
-  ``process_allgather`` and is summed in the same rank order — identical
-  math, in-mesh transport. jaxlib CPU wheels ship no such collectives, so
-  CI exercises the loopback-TCP path — which is the point: a REAL
-  N-process cluster instead of a skip.
+  shard's row count, and mean-reduced over the pluggable data plane
+  (``docs/ELASTIC_TRAINING.md`` "Data plane"). The default is the
+  chunk-pipelined peer-to-peer chain (``exec/comms.py``): gradient bytes
+  flow worker-to-worker over persistent loopback TCP, the coordinator
+  stays control-plane-only, and the rank-ordered accumulation keeps the
+  dense path bitwise-equal to the ``data_plane="star"`` fallback (PR 19's
+  coordinator-reduced HTTP path, kept as the parity oracle) and to
+  ``single_process_reference``. With ``DL4JTPU_CLUSTER_BACKEND=jax`` (and
+  a jaxlib whose backend actually ships cross-process collectives) the
+  same vector goes through a real ``process_allgather`` summed in the
+  same rank order — identical math, in-mesh transport.
 - **Elasticity.** A heartbeat thread renews the lease; any fenced RPC or
   rollback directive sends the worker to ``_resync``: restore the anchor
   checkpoint (bitwise, PR 4), ack the proposed generation, resume at the
@@ -38,24 +41,26 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import http.client
 import json
 import os
 import sys
 import threading
 import time
-import urllib.error
-import urllib.request
 from typing import Dict, Optional
+from urllib.parse import urlparse
 
 import numpy as np
 
+from deeplearning4j_tpu.exec.comms import (ChainComms, CommsAbortedError,
+                                           CommsError, record_star_bytes)
 from deeplearning4j_tpu.exec.elastic import (ClusterFullError, EvictedError,
                                              FencedError)
 from deeplearning4j_tpu.resilience.errors import TransientError
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 
 __all__ = ["CoordClient", "ElasticWorker", "synth_batch", "params_digest",
-           "main"]
+           "single_process_reference", "main"]
 
 # one bundle-validity envelope for the cluster's train programs (grad is
 # shape-specialized per shard-row count, update is shape-stable)
@@ -74,14 +79,25 @@ def synth_batch(model: str, seed: int, step: int, n: int):
     ``(model, seed, step)`` so every member (including a replacement that
     joined five generations later) slices identical bytes."""
     rng = np.random.default_rng([int(seed), int(step), 0xE1A])
-    if model == "mlp":
+    if model in ("mlp", "widemlp"):
         x = rng.standard_normal((n, 4)).astype(np.float32)
         labels = rng.integers(0, 3, size=n)
         y = np.zeros((n, 3), np.float32)
         y[np.arange(n), labels] = 1.0
         return x, y
+    if model == "charlstm":
+        from deeplearning4j_tpu.serving.replica import CHAR_VOCAB
+        T = 16
+        toks = rng.integers(0, CHAR_VOCAB, (n, T + 1))
+        x = np.zeros((n, T, CHAR_VOCAB), np.float32)
+        y = np.zeros((n, T, CHAR_VOCAB), np.float32)
+        ar = np.arange(T)
+        for i in range(n):   # next-token prediction on synthetic streams
+            x[i, ar, toks[i, :-1]] = 1.0
+            y[i, ar, toks[i, 1:]] = 1.0
+        return x, y
     raise ValueError(f"no synthetic batch source for model {model!r} "
-                     "(elastic cluster jobs are mlp)")
+                     "(elastic cluster jobs: mlp | widemlp | charlstm)")
 
 
 def params_digest(params) -> str:
@@ -94,51 +110,187 @@ def params_digest(params) -> str:
     return h.hexdigest()
 
 
+def dp_programs(net):
+    """The two jitted programs every data plane shares: a grad step that
+    returns ``(vec, new_state)`` with ``vec = [loss, flat-grads]`` already
+    flattened IN-GRAPH, and an update that takes the flat mean-grad vector
+    back and unravels it in-graph. Flatten/unflatten living inside XLA
+    instead of eager numpy is worth ~0.15 s/step on a ~13 MB-of-grads
+    model (ravel_pytree dispatches one eager op per leaf), and the wire
+    wants the flat vector anyway. Concatenate/reshape are pure layout, so
+    the arithmetic — and the bitwise parity contract between chain, star
+    and the single-process oracle — is unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    # grads mirror the param tree, so params donate the unravel closure
+    _, unravel = ravel_pytree(net.params)
+
+    def grad_step(params, state, x, y, rng):
+        (loss, new_state), grads = jax.value_and_grad(
+            net._dp_loss, has_aux=True)(params, state, x, y, rng)
+        flat, _ = ravel_pytree(grads)
+        vec = jnp.concatenate(
+            [jnp.reshape(loss, (1,)).astype(jnp.float32),
+             flat.astype(jnp.float32)])
+        return vec, new_state
+
+    def upd(params, opt_state, flat_grads):
+        return net._dp_apply_updates(params, opt_state,
+                                     unravel(flat_grads))
+
+    return jax.jit(grad_step), jax.jit(upd)
+
+
+def single_process_reference(model: str = "mlp", seed: int = 42,
+                             total_steps: int = 8, global_batch: int = 32,
+                             world: int = 2) -> dict:
+    """The cluster's exact arithmetic replayed in ONE process: per-rank
+    shard gradients from the same jitted program at the same shard
+    shapes, summed in rank order, divided by ``float32(total rows)``, one
+    shared update. This is the single-process oracle the dense data
+    planes (chain AND star) must match BITWISE — a literal big-batch fit
+    is only tolerance-close, because XLA's batch reduction associates
+    floats differently than the shard-wise rank-ordered sum."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.distributed import local_batch_slice
+    from deeplearning4j_tpu.serving.replica import build_model
+    net = build_model(model)
+    gj, uj = dp_programs(net)
+    reduced = None
+    for step in range(int(total_steps)):
+        x, y = synth_batch(model, seed, step, int(global_batch))
+        rng = jax.random.fold_in(jax.random.PRNGKey(int(seed)), step)
+        total, rows_sum, new_state = None, 0, net.state
+        for r in range(int(world)):
+            sl = local_batch_slice(int(global_batch), rank=r, world=world)
+            rows = sl.stop - sl.start
+            out, new_state = gj(net.params, net.state, x[sl], y[sl], rng)
+            vec = np.asarray(out, np.float32) * np.float32(rows)
+            total = vec.copy() if total is None else total + vec
+            rows_sum += rows
+        reduced = total / np.float32(rows_sum)
+        net.params, net.opt_state = uj(net.params, net.opt_state,
+                                       np.asarray(reduced[1:], np.float32))
+        net.state = new_state
+        net.iteration = step + 1
+    return {"params_digest": params_digest(net.params),
+            "final_loss": float(reduced[0]) if reduced is not None else None,
+            "steps": int(total_steps)}
+
+
 # --------------------------------------------------------------------------
 # coordinator client
 # --------------------------------------------------------------------------
+
+# socket-level failures meaning "the keep-alive connection died", not "the
+# coordinator answered an error" — eligible for the in-call reconnect (the
+# serving/client.py idiom; IncompleteRead covers a drop mid-response)
+_CONN_ERRORS = (http.client.RemoteDisconnected,
+                http.client.CannotSendRequest,
+                http.client.BadStatusLine,
+                http.client.IncompleteRead,
+                ConnectionError, BrokenPipeError, OSError)
+
 
 class CoordClient:
     """HTTP adapter to the ElasticCoordinator: every RPC goes through the
     shared retry primitive (``component="cluster"``), and coordinator
     verdicts come back as the elastic exceptions (409 stale_generation →
     FencedError, 410 → EvictedError) so the worker's control flow never
-    parses status codes."""
+    parses status codes.
+
+    Transport is one persistent keep-alive ``http.client.HTTPConnection``
+    per thread (the train loop and the heartbeat thread each own one —
+    connections are not thread-safe), the serving/client.py idiom: a
+    dropped socket reconnects ONCE within the call before the retry
+    policy sees an error. The control plane runs dozens of RPCs per
+    second per worker; re-dialing each one was measurable coordinator
+    load at N=4."""
 
     def __init__(self, base_url: str, worker_id: str, timeout: float = 5.0):
         self.base = base_url.rstrip("/")
+        parsed = urlparse(self.base)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or 80
         self.worker_id = worker_id
         self.timeout = timeout
+        self._local = threading.local()
 
     # -- transport ---------------------------------------------------------
-    def _raise_mapped(self, e: urllib.error.HTTPError):
+    def _conn(self, timeout: float) -> http.client.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(self.host, self.port,
+                                           timeout=timeout)
+            self._local.conn = c
+        else:
+            c.timeout = timeout
+            if c.sock is not None:
+                c.sock.settimeout(timeout)
+        return c
+
+    def close(self) -> None:
+        """Drop this thread's persistent connection; the next RPC redials."""
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:   # noqa: BLE001 — already-dead socket
+                pass
+            self._local.conn = None
+
+    def _roundtrip(self, method: str, path: str, body: Optional[bytes],
+                   headers: Dict[str, str], timeout: float):
+        # attempt 0 may find a keep-alive socket the coordinator already
+        # reaped; reconnect once within the call — a second failure is a
+        # real connection problem for the retry policy
+        for attempt in (0, 1):
+            conn = self._conn(timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except TimeoutError:
+                self.close()
+                raise
+            except _CONN_ERRORS as e:
+                self.close()
+                if attempt:
+                    # surface as retryable: the classifier treats a bare
+                    # OSError as fatal, but a dead coordinator socket is
+                    # exactly what the retry budget exists for
+                    raise TransientError(
+                        f"coordinator connection failed: {e!r}") from e
+
+    def _raise_mapped(self, status: int, data: bytes):
         try:
-            doc = json.loads(e.read().decode() or "{}")
-        except Exception:   # noqa: BLE001 — unparseable body: keep HTTPError
-            raise e from None
+            doc = json.loads(data.decode() or "{}")
+        except Exception:   # noqa: BLE001 — unparseable body
+            doc = {}
         kind = doc.get("error")
+        msg = doc.get("message", f"HTTP {status}")
         if kind == "stale_generation":
-            raise FencedError(doc.get("message", "fenced"),
-                              proposal=doc.get("proposal"),
-                              anchor=doc.get("anchor")) from None
+            raise FencedError(msg, proposal=doc.get("proposal"),
+                              anchor=doc.get("anchor"))
         if kind == "evicted":
-            raise EvictedError(doc.get("message", "evicted")) from None
+            raise EvictedError(msg)
         if kind == "cluster_full":
-            raise ClusterFullError(doc.get("message", "full")) from None
+            raise ClusterFullError(msg)
         if kind == "barrier_timeout":
-            raise TransientError(doc.get("message", "barrier")) from None
-        raise e
+            raise TransientError(msg)
+        if status in (429, 502, 503, 504):
+            raise TransientError(msg)
+        raise RuntimeError(f"coordinator HTTP {status}: {msg}")
 
     def _post_once(self, path: str, body: bytes, headers: Dict[str, str],
                    timeout: float) -> bytes:
-        req = urllib.request.Request(self.base + path, data=body,
-                                     headers=headers, method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            self._raise_mapped(e)
-            raise   # pragma: no cover — _raise_mapped always raises
+        status, data = self._roundtrip("POST", path, body, headers, timeout)
+        if status >= 400:
+            self._raise_mapped(status, data)
+        return data
 
     def _rpc(self, path: str, doc: dict, *, policy=_RPC_POLICY,
              timeout: Optional[float] = None) -> dict:
@@ -150,8 +302,9 @@ class CoordClient:
         return json.loads(out or b"{}")
 
     # -- RPCs --------------------------------------------------------------
-    def join(self) -> dict:
-        return self._rpc("/join", {"worker_id": self.worker_id})
+    def join(self, data_port: int = 0) -> dict:
+        return self._rpc("/join", {"worker_id": self.worker_id,
+                                   "data_port": int(data_port)})
 
     def sync(self, generation: int) -> dict:
         return self._rpc("/sync", {"worker_id": self.worker_id,
@@ -176,9 +329,11 @@ class CoordClient:
         self._rpc("/leave", {"worker_id": self.worker_id})
 
     def state(self) -> dict:
-        with urllib.request.urlopen(self.base + "/state",
-                                    timeout=self.timeout) as resp:
-            return json.loads(resp.read())
+        status, data = self._roundtrip("GET", "/state", None, {},
+                                       self.timeout)
+        if status >= 400:
+            self._raise_mapped(status, data)
+        return json.loads(data)
 
     def allreduce(self, generation: int, step: int, rows: int,
                   vec: np.ndarray) -> np.ndarray:
@@ -209,11 +364,17 @@ class _LeaseBox:
         self.step = 0
         self.directive = "none"
         self.proposal: Optional[int] = None
-        self.evicted = False
+        self.coord_gen = 0          # coordinator's committed generation
+        self.evicted = False        # as stamped on the last heartbeat
 
     def snapshot(self):
         with self._lock:
             return (self.directive, self.proposal, self.evicted)
+
+    def snapshot_full(self):
+        with self._lock:
+            return (self.directive, self.proposal, self.coord_gen,
+                    self.evicted)
 
     def set_progress(self, generation: int, step: int):
         with self._lock:
@@ -223,6 +384,7 @@ class _LeaseBox:
         with self._lock:
             self.directive = resp.get("directive", "none")
             self.proposal = resp.get("proposal")
+            self.coord_gen = int(resp.get("generation") or 0)
 
     def mark_evicted(self):
         with self._lock:
@@ -258,10 +420,18 @@ class ElasticWorker:
         self._upd_jit = None
         self._grad_exec: Dict[int, object] = {}     # rows → AOT program
         self._upd_exec = None
-        self._unravel = None
         self._cm = None
         self._stop_hb = threading.Event()
         self._use_jax_collectives = False
+        # data plane (exec/comms.py): the listener must exist before join
+        # so its port can ride the join RPC; the codec/bucket policy is
+        # adopted from the coordinator's config after join
+        self.comms: Optional[ChainComms] = ChainComms()
+        self._plane = "chain"
+        self._comm_seconds = 0.0
+        self._step_seconds = 0.0
+        self._star_sent = 0
+        self._star_recv = 0
 
     # -- logging -----------------------------------------------------------
     def _log(self, msg: str):
@@ -281,42 +451,114 @@ class ElasticWorker:
                 pass
 
     # -- membership --------------------------------------------------------
+    def _abort_check(self) -> bool:
+        """Should a blocked data-plane wait give up? Yes once the lease
+        layer has seen a rollback directive or our own eviction — the
+        membership changed, the current exchange can never complete."""
+        directive, proposal, evicted = self.box.snapshot()
+        if evicted:
+            return True
+        return (directive == "rollback"
+                and not self._stale_rollback(proposal))
+
+    def _stale_rollback(self, proposal: Optional[int]) -> bool:
+        """A heartbeat response computed DURING a reform can land after
+        that reform committed and we already resynced — its rollback
+        directive targets a generation we are already in. Acting on it
+        would tear down a healthy chain (peers mid-step would see EOF), so
+        directives that do not point PAST our committed generation are
+        ignored; the next heartbeat clears them."""
+        _, _, coord_gen, _ = self.box.snapshot_full()
+        return max(proposal or 0, coord_gen) <= self.generation
+
+    def _await_reform(self, why: str) -> Optional[int]:
+        """The data plane failed (peer died / chain torn): the coordinator
+        is the membership arbiter, so park until the lease detector turns
+        the failure into a reform proposal — or into our own eviction."""
+        cfg = self.cfg
+        deadline = time.monotonic() + (float(cfg.get("evict_after", 4.0))
+                                       + float(cfg.get("replacement_grace",
+                                                       8.0)) + 60.0)
+        interval = float(cfg.get("hb_interval", 0.25))
+        self._log(f"data plane failed ({why}); awaiting reform")
+        while time.monotonic() < deadline:
+            directive, proposal, coord_gen, evicted = \
+                self.box.snapshot_full()
+            if evicted:
+                raise EvictedError(f"{self.worker_id} evicted while "
+                                   "awaiting reform")
+            if (directive == "rollback"
+                    and not self._stale_rollback(proposal)):
+                return proposal
+            time.sleep(interval / 2)
+        raise CommsError(f"data plane failed ({why}) and no reform "
+                         "proposal arrived")
+
     def _resync(self, proposal: Optional[int]) -> None:
         """Ack ``proposal`` (or whatever supersedes it) until a generation
-        commits, then roll back to its anchor and adopt its (rank, world).
-        This is THE recovery path: initial formation, post-eviction reform,
-        degraded commit and replacement onboarding all land here."""
+        commits, then roll back to its anchor, adopt its (rank, world) and
+        rebuild the data plane. This is THE recovery path: initial
+        formation, post-eviction reform, degraded commit and replacement
+        onboarding all land here."""
         target = proposal or self.generation or 1
         interval = float(self.cfg.get("hb_interval", 0.25))
         while True:
             if self.box.snapshot()[2]:
                 raise EvictedError(f"{self.worker_id} evicted during sync")
             resp = self.client.sync(target)
-            if resp.get("status") == "go":
-                break
-            target = resp.get("proposal") or target
-            time.sleep(interval / 2)
-        self.generation = int(resp["generation"])
-        self.rank = int(resp["rank"])
-        self.world = int(resp["world"])
-        self.anchor = dict(resp.get("anchor") or
-                           {"step": 0, "path": None})
-        # rank-tag this process for flight-recorder spills and re-stamp the
-        # elastic topology + generation fence (parallel/distributed.py)
-        os.environ["DL4JTPU_RANK"] = str(self.rank)
-        os.environ["DL4JTPU_WORLD"] = str(self.world)
-        from deeplearning4j_tpu.parallel import distributed as dist
-        dist.initialize(process_id=self.rank, num_processes=self.world,
-                        generation=self.generation)
-        self._restore_anchor()
-        self.step = int(self.anchor.get("step") or 0)
-        self.box.set_progress(self.generation, self.step)
-        # clear any directive a pre-commit heartbeat left behind; a stale
-        # one only costs a harmless replay from the anchor (reduced steps
-        # are cached, so replayed contributions read the same vectors)
-        self.box.absorb({"directive": "none", "proposal": None})
-        self._log(f"generation={self.generation} rank={self.rank} "
-                  f"world={self.world} anchor_step={self.step}")
+            if resp.get("status") != "go":
+                target = resp.get("proposal") or target
+                time.sleep(interval / 2)
+                continue
+            reconfigure = (int(resp["generation"]) != self.generation
+                           or (self.comms is not None
+                               and self.comms.generation
+                               != int(resp["generation"])))
+            self.generation = int(resp["generation"])
+            self.rank = int(resp["rank"])
+            self.world = int(resp["world"])
+            self.anchor = dict(resp.get("anchor") or
+                               {"step": 0, "path": None})
+            # rank-tag this process for flight-recorder spills and re-stamp
+            # the elastic topology + generation fence
+            # (parallel/distributed.py)
+            os.environ["DL4JTPU_RANK"] = str(self.rank)
+            os.environ["DL4JTPU_WORLD"] = str(self.world)
+            from deeplearning4j_tpu.parallel import distributed as dist
+            dist.initialize(process_id=self.rank, num_processes=self.world,
+                            generation=self.generation)
+            self._restore_anchor()
+            self.step = int(self.anchor.get("step") or 0)
+            self.box.set_progress(self.generation, self.step)
+            # clear any directive a pre-commit heartbeat left behind; a
+            # stale one only costs a harmless replay from the anchor
+            # (reduced steps are cached, so replayed contributions read the
+            # same vectors)
+            self.box.absorb({"directive": "none", "proposal": None,
+                             "generation": self.generation})
+            self._log(f"generation={self.generation} rank={self.rank} "
+                      f"world={self.world} anchor_step={self.step}")
+            if self._plane != "chain" or self.comms is None or not reconfigure:
+                return
+            # rebuild the peer chain from the committed view's endpoints;
+            # configure() also resets the threshold codec on a generation
+            # change — a stale pre-reform residual must never survive into
+            # the new membership
+            eps = {int(r): (hp[0], int(hp[1]))
+                   for r, hp in (resp.get("endpoints") or {}).items()}
+            try:
+                self.comms.configure(self.generation, self.rank, self.world,
+                                     eps, should_abort=self._abort_check)
+                return
+            except CommsAbortedError:
+                # another reform started while we formed — resync to it
+                target = self.box.snapshot()[1] or target
+                continue
+            except CommsError as e:
+                # a peer died between commit and chain formation: the lease
+                # detector will turn that into the next proposal
+                target = self._await_reform(f"chain formation: {e}") or target
+                continue
 
     def _restore_anchor(self) -> None:
         path = self.anchor.get("path")
@@ -336,24 +578,11 @@ class ElasticWorker:
             self.net = build_model(self.cfg["model"])
             self._grad_exec.clear()
             self._upd_exec = None
-            self._unravel = None
             self._build_programs()
         self.net.iteration = 0
 
     # -- programs ----------------------------------------------------------
     def _build_programs(self) -> None:
-        import jax
-        net = self.net
-
-        def grad_step(params, state, x, y, rng):
-            (loss, new_state), grads = jax.value_and_grad(
-                net._dp_loss, has_aux=True)(params, state, x, y, rng)
-            return loss, new_state, grads
-
-        def upd(params, opt_state, grads):
-            return net._dp_apply_updates(params, opt_state, grads)
-
-        self._grad_jit = jax.jit(grad_step)
         # NO donate_argnums on the update: after a rollback the params /
         # opt_state leaves are numpy arrays zero-copy-aliased by
         # restore_into, and donating buffers that host memory still aliases
@@ -361,7 +590,7 @@ class ElasticWorker:
         # self.net.params then mutate between steps, breaking bitwise
         # recovery parity (race-dependent; surfaced only under the
         # cluster's barrier delays + heartbeat thread).
-        self._upd_jit = jax.jit(upd)
+        self._grad_jit, self._upd_jit = dp_programs(self.net)
 
     def _model_sig(self) -> str:
         from deeplearning4j_tpu.exec.aot import model_signature
@@ -397,7 +626,7 @@ class ElasticWorker:
         grad program at the current shard width + the update program."""
         from deeplearning4j_tpu.exec.aot import (AotBundle, companion_path,
                                                  export_compiled)
-        params, state, x, y, rng, grads = example
+        params, state, x, y, rng, flat_grads = example
         try:
             bundle = AotBundle(self._model_sig(), _AOT_PRECISION)
             bundle.add_compiled(f"cluster:grad:b{x.shape[0]}",
@@ -405,8 +634,8 @@ class ElasticWorker:
                                                 (params, state, x, y, rng)))
             bundle.add_compiled("cluster:update",
                                 export_compiled(self._upd_jit,
-                                                (params,
-                                                 self.net.opt_state, grads)))
+                                                (params, self.net.opt_state,
+                                                 flat_grads)))
             bundle.save(companion_path(ckpt_path))
         except Exception as e:    # noqa: BLE001 — AOT is an accelerant,
             self._log(f"CLUSTER_AOT export failed: {e}")  # never a blocker
@@ -443,23 +672,35 @@ class ElasticWorker:
             return False
 
     def _reduce(self, rows: int, vec: np.ndarray) -> np.ndarray:
-        if self._use_jax_collectives:
-            from jax.experimental import multihost_utils
-            gathered = multihost_utils.process_allgather(vec)
-            rows_all = multihost_utils.process_allgather(
-                np.float32(rows))
-            total = gathered[0].copy()
-            for r in range(1, gathered.shape[0]):   # rank order: bitwise
-                total = total + gathered[r]
-            return np.asarray(total / np.float32(rows_all.sum()))
-        return self.client.allreduce(self.generation, self.step, rows, vec)
+        t0 = time.perf_counter()
+        try:
+            if self._use_jax_collectives:
+                from jax.experimental import multihost_utils
+                gathered = multihost_utils.process_allgather(vec)
+                rows_all = multihost_utils.process_allgather(
+                    np.float32(rows))
+                total = gathered[0].copy()
+                for r in range(1, gathered.shape[0]):  # rank order: bitwise
+                    total = total + gathered[r]
+                return np.asarray(total / np.float32(rows_all.sum()))
+            if self._plane == "chain" and self.comms is not None:
+                return self.comms.allreduce(self.step, vec, rows,
+                                            should_abort=self._abort_check)
+            out = self.client.allreduce(self.generation, self.step, rows,
+                                        vec)
+            self._star_sent += vec.nbytes
+            self._star_recv += out.nbytes
+            record_star_bytes(vec.nbytes, out.nbytes)
+            return out
+        finally:
+            self._comm_seconds += time.perf_counter() - t0
 
     # -- training ----------------------------------------------------------
     def _train_step(self, chaos) -> None:
         import jax
-        from jax.flatten_util import ravel_pytree
 
         from deeplearning4j_tpu.parallel.distributed import local_batch_slice
+        t_step = time.perf_counter()
         net, cfg, step = self.net, self.cfg, self.step
         chaos.on_step(step)
         gb = int(cfg["global_batch"])
@@ -468,27 +709,24 @@ class ElasticWorker:
         rows = sl.stop - sl.start
         rng = jax.random.fold_in(jax.random.PRNGKey(int(cfg["seed"])), step)
         fn = self._grad_exec.get(rows, self._grad_jit)
-        loss, new_state, grads = fn(net.params, net.state, x[sl], y[sl], rng)
-        flat, unravel = ravel_pytree(grads)
-        if self._unravel is None:
-            self._unravel = unravel
-        vec = np.concatenate(
-            [np.float32([loss]), np.asarray(flat, np.float32)])
+        out, new_state = fn(net.params, net.state, x[sl], y[sl], rng)
+        vec = np.asarray(out, np.float32)
         reduced = self._reduce(rows, vec * np.float32(rows))
         self.last_loss = float(reduced[0])
-        mean_grads = self._unravel(np.asarray(reduced[1:], np.float32))
+        flat_mean = np.asarray(reduced[1:], np.float32)
         upd = self._upd_exec or self._upd_jit
         if os.environ.get("DL4JTPU_CLUSTER_TRACE"):
             self._log(f"TRACE-IN step={step} "
                       f"p={params_digest(net.params)[:8]} "
                       f"o={params_digest(net.opt_state)[:8]} "
-                      f"g={params_digest(mean_grads)[:8]}")
+                      f"g={params_digest(flat_mean)[:8]}")
         net.params, net.opt_state = upd(net.params, net.opt_state,
-                                        mean_grads)
+                                        flat_mean)
         net.state = new_state
         net.iteration = step + 1
         self.step = step + 1
         self.box.set_progress(self.generation, self.step)
+        self._step_seconds += time.perf_counter() - t_step
         if os.environ.get("DL4JTPU_CLUSTER_TRACE"):
             rd = hashlib.blake2b(
                 np.ascontiguousarray(reduced).tobytes(),
@@ -498,9 +736,9 @@ class ElasticWorker:
                       f"reduced={rd} opt={params_digest(net.opt_state)} "
                       f"digest={params_digest(net.params)}")
         self._maybe_checkpoint((net.params, net.state, x[sl], y[sl], rng),
-                               mean_grads)
+                               flat_mean)
 
-    def _maybe_checkpoint(self, grad_example, grads) -> None:
+    def _maybe_checkpoint(self, grad_example, flat_grads) -> None:
         cfg, step = self.cfg, self.step
         every = int(cfg.get("ckpt_every") or 0)
         final = step >= int(cfg["total_steps"])
@@ -515,7 +753,7 @@ class ElasticWorker:
         path = self._cm.save(self.net)
         if cfg.get("aot", True):
             params, state, x, y, rng = grad_example
-            self._export_aot(path, (params, state, x, y, rng, grads))
+            self._export_aot(path, (params, state, x, y, rng, flat_grads))
         self._cm.set_anchor(self.net.iteration)
         self.client.anchor(self.generation, step, path)
         self.anchor = {"step": step, "path": path}
@@ -527,26 +765,43 @@ class ElasticWorker:
         from deeplearning4j_tpu.util.compile_cache import setup_compile_cache
         setup_compile_cache()
         try:
-            joined = self.client.join()
+            joined = self.client.join(data_port=self.comms.data_port)
         except ClusterFullError as e:
             self._log(f"join rejected: {e}")
             return 4
         self.cfg = joined["config"]
         self.rejoined = bool(joined.get("proposal", 1) > 1)
+        self._plane = str(self.cfg.get("data_plane", "chain"))
+        if self._plane == "chain":
+            self.comms.set_policy(
+                str(self.cfg.get("codec", "dense")),
+                float(self.cfg.get("bucket_mb", 4.0)),
+                {k: float(self.cfg[k]) for k in
+                 ("threshold", "min_threshold", "threshold_step",
+                  "capacity_fraction") if k in self.cfg})
+        else:
+            # star: gradient bytes go through the coordinator; no peer
+            # listener needed
+            self.comms.close()
+            self.comms = None
         if self.port_file:
             tmp = self.port_file + ".tmp"
             with open(tmp, "w") as f:
                 f.write(f"{os.getpid()}\n")
             os.replace(tmp, self.port_file)
 
+        # lease alive BEFORE the expensive part: building + jitting the
+        # model can outlast evict_after on a contended host (N workers
+        # compiling concurrently), and a worker evicted mid-compile never
+        # even reaches its first step
+        hb = threading.Thread(target=self._hb_loop, name="cluster-hb",
+                              daemon=True)
+        hb.start()
+
         from deeplearning4j_tpu.serving.replica import build_model
         self.net = build_model(self.cfg["model"])
         self._build_programs()
         chaos = WorkerChaos.from_env()
-
-        hb = threading.Thread(target=self._hb_loop, name="cluster-hb",
-                              daemon=True)
-        hb.start()
         try:
             self._resync(joined.get("proposal"))
             self._use_jax_collectives = self._probe_jax_collectives()
@@ -556,6 +811,13 @@ class ElasticWorker:
                 if evicted:
                     raise EvictedError(f"{self.worker_id} lease lost")
                 if directive == "rollback":
+                    if self._stale_rollback(proposal):
+                        # late echo of a reform we already synced past —
+                        # acting on it would tear down a healthy chain
+                        self.box.absorb({"directive": "none",
+                                         "proposal": None,
+                                         "generation": self.generation})
+                        continue
                     self._use_jax_collectives = False
                     self._resync(proposal)
                     continue
@@ -565,6 +827,13 @@ class ElasticWorker:
                     self._log(f"fenced at step {self.step}: {e}")
                     self._use_jax_collectives = False
                     self._resync(e.proposal)
+                except CommsError as e:
+                    # the peer chain tore mid-step (a SIGKILLed neighbor,
+                    # or our abort on a rollback directive): wait for the
+                    # coordinator's verdict, then walk the normal resync
+                    proposal = self._await_reform(f"step {self.step}: {e}")
+                    self._use_jax_collectives = False
+                    self._resync(proposal)
             self._finish()
             return 0
         except EvictedError as e:
@@ -572,15 +841,35 @@ class ElasticWorker:
             return 3
         finally:
             self._stop_hb.set()
+            if self.comms is not None:
+                self.comms.close()
 
     def _finish(self) -> None:
+        comms = {"data_plane": self._plane,
+                 "codec": (self.comms.codec if self.comms is not None
+                           else "dense"),
+                 "comm_seconds": round(self._comm_seconds, 4),
+                 "step_seconds": round(self._step_seconds, 4)}
+        if self.comms is not None:
+            comms["bytes_sent"] = self.comms.bytes_sent
+            comms["bytes_recv"] = self.comms.bytes_recv
+            comms["compression_ratio"] = self.comms.last.get(
+                "compression_ratio", 1.0)
+            comms["residual_resets"] = (
+                self.comms.codec_state.resets
+                if self.comms.codec_state is not None else 0)
+        else:
+            comms["bytes_sent"] = self._star_sent
+            comms["bytes_recv"] = self._star_recv
+            comms["compression_ratio"] = 1.0
         payload = {"worker_id": self.worker_id, "rank": self.rank,
                    "world": self.world, "generation": self.generation,
                    "steps": self.step, "iteration": self.net.iteration,
                    "final_loss": self.last_loss,
                    "params_digest": params_digest(self.net.params),
                    "aot_restored": self.aot_restored,
-                   "rejoined": self.rejoined}
+                   "rejoined": self.rejoined,
+                   "comms": comms}
         self.client.result(payload)
         self._log(f"done digest={payload['params_digest']} "
                   f"loss={self.last_loss}")

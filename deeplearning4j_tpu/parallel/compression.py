@@ -28,6 +28,29 @@ import jax
 import jax.numpy as jnp
 
 
+def adapt_threshold(threshold, count, capacity, *, step, min_threshold):
+    """The EncodingHandler threshold-adaptation policy, shared by the
+    in-process handler below, the compiled collective exchange
+    (scaleout/training_master.py) and the cluster wire codec
+    (exec/comms.ThresholdCodec): saturated (count >= capacity) raises the
+    threshold one step; sparse (count < capacity // 4) decays it one step
+    toward the floor; otherwise unchanged."""
+    if count >= capacity:
+        return threshold + step
+    if count < capacity // 4:
+        return max(min_threshold, threshold - step)
+    return threshold
+
+
+def adapt_threshold_jnp(threshold, count, capacity, *, step, min_threshold):
+    """Traced twin of ``adapt_threshold`` (``capacity`` static, the rest
+    traced) for use inside jit/shard_map programs."""
+    return jnp.where(
+        count >= capacity, threshold + step,
+        jnp.where(count < capacity // 4,
+                  jnp.maximum(min_threshold, threshold - step), threshold))
+
+
 @partial(jax.jit, static_argnums=(2,))
 def threshold_encode(grad, threshold, capacity):
     """Encode |g| >= threshold entries, at most ``capacity`` of them (largest
@@ -96,11 +119,9 @@ class EncodingHandler:
     def _adapt(self, count, cap):
         """Threshold decay when too little is sent; periodic 'shake' lowers
         it to flush stale residuals (EncodingHandler semantics)."""
-        if count >= cap:            # saturated: raise threshold
-            self.threshold += self.threshold_step
-        elif count < cap // 4:      # sparse: decay toward min
-            self.threshold = max(self.min_threshold,
-                                 self.threshold - self.threshold_step)
+        self.threshold = adapt_threshold(
+            self.threshold, count, cap, step=self.threshold_step,
+            min_threshold=self.min_threshold)
         if (self.shake_frequency and self.iteration > 0
                 and self.iteration % self.shake_frequency == 0):
             self.threshold = max(self.min_threshold, self.threshold * 0.5)

@@ -210,7 +210,7 @@ class SharedTrainingMaster(TrainingMaster):
         from deeplearning4j_tpu.util.shmap import shard_map
         from jax.sharding import PartitionSpec as P
         from deeplearning4j_tpu.parallel.compression import (
-            threshold_encode, threshold_decode)
+            adapt_threshold_jnp, threshold_encode, threshold_decode)
         mesh = self.mesh
         step = jnp.float32(self.threshold_step)
         min_thr = jnp.float32(self.min_threshold)
@@ -238,14 +238,11 @@ class SharedTrainingMaster(TrainingMaster):
                 msg = threshold_decode(idx, vals, n)
                 residual = u - msg
                 vec = vec - jax.lax.psum(msg, "data")
-                # EncodingHandler._adapt: raise when saturated, decay when
-                # under a quarter full (per worker, as per executor in the
-                # reference)
-                threshold = jnp.where(
-                    count >= capacity, threshold + step,
-                    jnp.where(count < capacity // 4,
-                              jnp.maximum(min_thr, threshold - step),
-                              threshold))
+                # EncodingHandler._adapt via the shared policy (per
+                # worker, as per executor in the reference)
+                threshold = adapt_threshold_jnp(
+                    threshold, count, capacity, step=step,
+                    min_threshold=min_thr)
                 return (vec, residual, threshold), loss
             (vec, residual, threshold), losses = jax.lax.scan(
                 body, (vec, residual, threshold), (xs, ys))

@@ -57,6 +57,21 @@ def build_model(name: str):
                 .set_input_type(InputType.feed_forward(4))
                 .build())
         return MultiLayerNetwork(conf).init()
+    if name == "widemlp":
+        # comms-heavy variant of "mlp" (same 4-feature task, ~13 MB of
+        # f32 params) — big enough that the elastic bench's gradient
+        # exchange dominates a step, which is what the chain-vs-star
+        # throughput comparison needs to measure
+        conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2))
+                .weight_init("xavier").list()
+                .layer(DenseLayer(n_out=1024, activation="relu"))
+                .layer(DenseLayer(n_out=2048, activation="relu"))
+                .layer(DenseLayer(n_out=512, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        return MultiLayerNetwork(conf).init()
     if name == "charlstm":
         conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(1e-2))
                 .weight_init("xavier").list()
@@ -90,7 +105,7 @@ def build_model(name: str):
                                seed=42).init()
     raise ValueError(
         f"unknown replica model {name!r} "
-        f"(mlp | charlstm | charlstm-draft | tinyattn)")
+        f"(mlp | widemlp | charlstm | charlstm-draft | tinyattn)")
 
 
 def build_server(model_name: str = "charlstm", port: int = 0,

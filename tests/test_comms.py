@@ -1,0 +1,384 @@
+"""Gradient data-plane tests (exec/comms.py) — all in-process.
+
+Three layers:
+
+- **Pure pieces** — ``bucketize`` edge cases (ragged last bucket, tiny
+  model smaller than one bucket), the exact sparse/dense wire encoding
+  roundtrip, and ``ThresholdCodec``'s bitwise parity with the existing
+  ``parallel.compression.EncodingHandler`` (residual carry + threshold
+  trajectory).
+- **Chain arithmetic** — N ``ChainComms`` members on loopback threads must
+  produce output BITWISE-equal to the star coordinator's rank-ordered
+  ``total + v`` loop and single f32 division, including across ragged
+  buckets and repeated steps on the same sockets.
+- **Elasticity** — a peer death mid-allreduce surfaces ``CommsError`` (not
+  a hang), survivors ``configure()`` a new generation over loopback and
+  complete; residuals reset on the generation change (the stale-residual
+  fencing regression).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.exec.comms import (ChainComms, CommsAbortedError,
+                                           CommsError, ThresholdCodec,
+                                           bucketize, decode_bucket,
+                                           encode_bucket)
+
+
+# ---------------------------------------------------------------------------
+# bucketize
+# ---------------------------------------------------------------------------
+
+def test_bucketize_head_plus_fixed_body_with_ragged_tail():
+    per = 256 * 1024       # 1 MB of f32
+    n = 1 + per + per + 100
+    got = bucketize(n, bucket_mb=1.0)
+    assert got == [(0, 1), (1, 1 + per), (1 + per, 1 + 2 * per),
+                   (1 + 2 * per, n)]
+    # buckets tile [0, n) exactly
+    assert got[0][0] == 0 and got[-1][1] == n
+    assert all(a2 == b1 for (_, b1), (a2, _) in zip(got, got[1:]))
+
+
+def test_bucketize_tiny_model_single_ragged_bucket():
+    # model far smaller than one bucket: head + one ragged body bucket
+    assert bucketize(5, bucket_mb=4.0) == [(0, 1), (1, 5)]
+    # degenerate: the vector IS the head
+    assert bucketize(1, bucket_mb=4.0) == [(0, 1)]
+    with pytest.raises(ValueError):
+        bucketize(0, bucket_mb=4.0)
+
+
+def test_bucketize_exact_multiple_has_no_ragged_tail():
+    per = max(1, int(0.001 * 1024 * 1024) // 4)
+    got = bucketize(1 + 3 * per, bucket_mb=0.001)
+    assert len(got) == 4
+    assert all(b - a == per for a, b in got[1:])
+
+
+# ---------------------------------------------------------------------------
+# exact wire encoding
+# ---------------------------------------------------------------------------
+
+def test_encode_bucket_sparse_when_it_wins_dense_otherwise():
+    dense = np.arange(1, 9, dtype=np.float32)          # all nonzero
+    wire, payload = encode_bucket(dense)
+    assert wire == 0 and len(payload) == dense.size * 4
+    np.testing.assert_array_equal(decode_bucket(wire, payload, 8), dense)
+
+    sparse = np.zeros(100, np.float32)
+    sparse[[3, 97]] = [-2.5, 7.0]                      # 2·8 < 100·4
+    wire, payload = encode_bucket(sparse)
+    assert wire == 1 and len(payload) == 2 * 8
+    np.testing.assert_array_equal(decode_bucket(wire, payload, 100), sparse)
+
+
+def test_decode_bucket_rejects_corrupt_payloads():
+    with pytest.raises(CommsError):
+        decode_bucket(0, b"\0" * 8, 3)         # dense size mismatch
+    with pytest.raises(CommsError):
+        decode_bucket(1, b"\0" * 12, 4)        # sparse not 8-aligned
+    bad_idx = (np.array([9], np.int32).tobytes()
+               + np.array([1.0], np.float32).tobytes())
+    with pytest.raises(CommsError):
+        decode_bucket(1, bad_idx, 4)           # index out of range
+
+
+# ---------------------------------------------------------------------------
+# threshold codec parity with the scaleout implementation
+# ---------------------------------------------------------------------------
+
+def test_threshold_codec_matches_encoding_handler_bitwise():
+    """The wire codec re-implements EncodingHandler in host numpy; decoded
+    message, residual carry and the adaptive-threshold trajectory must
+    stay bitwise-identical over many steps."""
+    from deeplearning4j_tpu.parallel.compression import (EncodingHandler,
+                                                         threshold_decode)
+    n, steps = 400, 12
+    rng = np.random.default_rng(0)
+    ref = EncodingHandler(threshold=1e-2, min_threshold=1e-4,
+                          threshold_step=1e-3, capacity_fraction=0.1)
+    ours = ThresholdCodec(n, threshold=1e-2, min_threshold=1e-4,
+                          threshold_step=1e-3, capacity_fraction=0.1)
+    for _ in range(steps):
+        g = rng.normal(scale=0.05, size=n).astype(np.float32)
+        idx, vals, _ = ref.encode(g)
+        ref_msg = np.asarray(threshold_decode(idx, vals, n))
+        msg = ours.encode(g)
+        np.testing.assert_array_equal(msg, ref_msg)
+        np.testing.assert_array_equal(ours.residual, np.asarray(ref.residual))
+        assert ours.threshold == pytest.approx(ref.threshold, abs=0)
+
+
+def test_threshold_codec_reset_clears_residual_and_threshold_walk():
+    from deeplearning4j_tpu.monitor import get_registry
+    c = ThresholdCodec(50, threshold=1e-2, capacity_fraction=0.2)
+    c.encode(np.full(50, 0.5, np.float32))
+    assert np.abs(c.residual).sum() > 0
+    assert c.threshold != pytest.approx(1e-2, abs=0)   # walked by adapt
+    before = get_registry().render()
+    c.reset()
+    assert not c.residual.any()
+    assert c.threshold == 1e-2
+    assert c.resets == 1
+    after = get_registry().render()
+    assert "dl4jtpu_cluster_residual_resets_total" in after
+    assert after != before
+
+
+# ---------------------------------------------------------------------------
+# the chain itself (loopback, in-process threads)
+# ---------------------------------------------------------------------------
+
+def _form_chain(n, generation=1, **kw):
+    members = [ChainComms(**kw) for _ in range(n)]
+    eps = {r: ("127.0.0.1", m.data_port) for r, m in enumerate(members)}
+    errs = []
+
+    def cfg(r):
+        try:
+            members[r].configure(generation, r, n, eps)
+        except BaseException as e:     # noqa: BLE001 — surfaced below
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=cfg, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs, errs
+    return members, eps
+
+
+def _chain_step(members, step, vecs, rows):
+    out = [None] * len(members)
+    errs = []
+
+    def go(r):
+        try:
+            out[r] = members[r].allreduce(step, vecs[r], rows[r])
+        except BaseException as e:     # noqa: BLE001
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=go, args=(r,)) for r in range(len(members))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    return out, errs
+
+
+def _star_reference(vecs, rows):
+    """PR 19's coordinator arithmetic: rank-ordered ``total + v`` then one
+    f32 division — the bitwise oracle."""
+    total = None
+    for v in vecs:
+        total = v.copy() if total is None else total + v
+    return total / np.float32(sum(rows))
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_dense_chain_bitwise_equals_star_across_steps(world):
+    n = 1001                     # head + 40 ragged micro-buckets
+    members, _ = _form_chain(world, bucket_mb=0.0001)
+    try:
+        rng = np.random.default_rng(7)
+        rows = [11] * (world - 1) + [10]
+        for step in range(3):    # several steps over the SAME sockets
+            vecs = [rng.normal(size=n).astype(np.float32)
+                    for _ in range(world)]
+            out, errs = _chain_step(members, step, vecs, rows)
+            assert not errs, errs
+            want = _star_reference(vecs, rows)
+            for r in range(world):
+                np.testing.assert_array_equal(out[r], want)
+            assert members[0].last["buckets"] > 30
+    finally:
+        for m in members:
+            m.close()
+
+
+def test_tiny_model_and_world_one_short_circuit():
+    # smaller than any bucket: 2 buckets, still exact
+    members, _ = _form_chain(2, bucket_mb=4.0)
+    try:
+        vecs = [np.array([2.0, 4.0, 6.0], np.float32),
+                np.array([1.0, 3.0, 5.0], np.float32)]
+        out, errs = _chain_step(members, 0, vecs, [1, 1])
+        assert not errs, errs
+        np.testing.assert_array_equal(out[0], _star_reference(vecs, [1, 1]))
+        assert members[0].last["buckets"] == 2
+    finally:
+        for m in members:
+            m.close()
+    # world of one never touches a socket
+    solo = ChainComms()
+    try:
+        solo.configure(1, 0, 1, {})
+        got = solo.allreduce(0, np.array([3.0, 9.0], np.float32), 2)
+        np.testing.assert_array_equal(got, np.array([1.5, 4.5], np.float32))
+    finally:
+        solo.close()
+
+
+def test_threshold_chain_transports_exact_compressed_sums():
+    """With codec="threshold" each member compresses its OWN contribution
+    once; the chain's job is to move those messages EXACTLY. The reduced
+    output must equal the star arithmetic applied to the encoded
+    messages (head element always exact)."""
+    n = 257
+    members, _ = _form_chain(2, codec="threshold", bucket_mb=0.0001,
+                             codec_opts={"threshold": 1e-2,
+                                         "capacity_fraction": 0.1})
+    try:
+        rng = np.random.default_rng(3)
+        vecs = [rng.normal(scale=0.05, size=n).astype(np.float32)
+                for _ in range(2)]
+        refs = [ThresholdCodec(n - 1, threshold=1e-2, capacity_fraction=0.1)
+                for _ in range(2)]
+        want_msgs = [np.concatenate([v[:1], c.encode(v[1:])])
+                     for v, c in zip(vecs, refs)]
+        out, errs = _chain_step(members, 0, vecs, [4, 4])
+        assert not errs, errs
+        want = _star_reference(want_msgs, [4, 4])
+        np.testing.assert_array_equal(out[0], want)
+        np.testing.assert_array_equal(out[1], want)
+        # sparse wire actually engaged and beat dense
+        assert members[0].last["compression_ratio"] > 1.5
+        # residual carried worker-side
+        assert np.abs(members[0].codec_state.residual).sum() > 0
+    finally:
+        for m in members:
+            m.close()
+
+
+def test_peer_death_mid_allreduce_raises_comms_error_then_chain_reforms():
+    """SIGKILL equivalent: rank 1 of 3 vanishes mid-exchange (sockets torn)
+    — both survivors surface CommsError promptly instead of hanging; a new
+    generation then reconfigures rank 0 and old rank 2 as a 2-chain on the
+    SAME listeners and reduces correctly."""
+    n = 40_000
+    members, _ = _form_chain(3, bucket_mb=0.01)
+    try:
+        vecs = [np.full(n, float(r + 1), np.float32) for r in range(3)]
+        killed = threading.Event()
+
+        def assassin():
+            killed.wait(timeout=10)
+            members[1].close()          # tears both of rank 1's sockets
+
+        t = threading.Thread(target=assassin)
+        t.start()
+        out = [None, None]
+        errs = []
+
+        def survivor(r):
+            try:
+                if r == 0:
+                    killed.set()        # die once rank 0 is inside
+                out[0 if r == 0 else 1] = \
+                    members[r].allreduce(0, vecs[r], 1)
+            except CommsError as e:
+                errs.append((r, e))
+
+        ts = [threading.Thread(target=survivor, args=(r,)) for r in (0, 2)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=30)
+        t.join(timeout=15)
+        # many-bucket exchange with a torn middle: at least one survivor
+        # must observe the failure (whichever side the cut reached first),
+        # and nobody may hang
+        assert errs, "no survivor noticed the dead peer"
+        assert all(not th.is_alive() for th in ts)
+
+        # reform: generation 2, survivors re-ranked 0 and 1
+        surv = [members[0], members[2]]
+        eps = {r: ("127.0.0.1", m.data_port) for r, m in enumerate(surv)}
+        cfg_errs = []
+
+        def cfg(r):
+            try:
+                surv[r].configure(2, r, 2, eps)
+            except BaseException as e:  # noqa: BLE001
+                cfg_errs.append((r, e))
+
+        ts = [threading.Thread(target=cfg, args=(r,)) for r in range(2)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=30)
+        assert not cfg_errs, cfg_errs
+        out2, errs2 = _chain_step(surv, 0, [vecs[0], vecs[2]], [1, 1])
+        assert not errs2, errs2
+        want = _star_reference([vecs[0], vecs[2]], [1, 1])
+        np.testing.assert_array_equal(out2[0], want)
+        np.testing.assert_array_equal(out2[1], want)
+    finally:
+        for m in members:
+            m.close()
+
+
+def test_should_abort_interrupts_a_stuck_peer_wait():
+    """The lease layer learned of a reform while we were blocked on a peer
+    that will never answer: should_abort flips and the allreduce raises
+    CommsAbortedError instead of waiting out io_timeout."""
+    members, _ = _form_chain(2, bucket_mb=4.0)
+    try:
+        flag = threading.Event()
+        flag.set()
+        with pytest.raises(CommsAbortedError):
+            # rank 0 sends its bucket then blocks on the bcast that rank 1
+            # (never calling allreduce) will not produce
+            members[0].allreduce(0, np.ones(8, np.float32), 1,
+                                 should_abort=flag.is_set)
+    finally:
+        for m in members:
+            m.close()
+
+
+def test_configure_resets_residual_on_generation_change():
+    """Stale-residual fencing regression: error feedback accumulated under
+    generation g must be dropped when the chain reconfigures for g+1 — and
+    only on an actual generation CHANGE (same-generation reconfigure of a
+    world-1 chain keeps it)."""
+    c = ChainComms(codec="threshold",
+                   codec_opts={"threshold": 1e-2, "capacity_fraction": 0.2})
+    try:
+        c.configure(1, 0, 1, {})
+        c.allreduce(0, np.full(64, 0.5, np.float32), 1)
+        assert c.codec_state is not None
+        assert np.abs(c.codec_state.residual).sum() > 0
+        resets0 = c.codec_state.resets
+        c.configure(1, 0, 1, {})                   # same generation: kept
+        assert np.abs(c.codec_state.residual).sum() > 0
+        assert c.codec_state.resets == resets0
+        c.configure(2, 0, 1, {})                   # reform: dropped
+        assert not c.codec_state.residual.any()
+        assert c.codec_state.resets == resets0 + 1
+    finally:
+        c.close()
+
+
+def test_allreduce_emits_comm_metrics():
+    from deeplearning4j_tpu.monitor import get_registry
+    members, _ = _form_chain(2, bucket_mb=0.001)
+    try:
+        vecs = [np.ones(600, np.float32), np.ones(600, np.float32)]
+        out, errs = _chain_step(members, 0, vecs, [1, 1])
+        assert not errs, errs
+        text = get_registry().render()
+        assert 'dl4jtpu_cluster_comm_bytes_total{' in text
+        assert 'direction="sent"' in text and 'direction="recv"' in text
+        assert "dl4jtpu_cluster_compression_ratio" in text
+        assert "dl4jtpu_cluster_bucket_seconds" in text
+        for m in members:
+            assert m.bytes_sent > 0 and m.bytes_recv > 0
+            assert m.last["wall_s"] > 0
+    finally:
+        for m in members:
+            m.close()

@@ -225,6 +225,74 @@ def test_allreduce_rank_order_deterministic_and_idempotent():
     assert coord.reduced_steps == 1
 
 
+def test_reduced_cache_evicts_beyond_keep_window():
+    """The star path caches reduced vectors so a worker whose HTTP timed
+    out can re-read its step — but only the last ``_REDUCED_KEEP`` of
+    them, oldest evicted first, or a long run would pin every gradient
+    ever reduced in coordinator memory."""
+    from deeplearning4j_tpu.exec.elastic import _REDUCED_KEEP
+    coord, _ = _form(2)
+    v = np.ones(2, np.float32)
+    steps = _REDUCED_KEEP + 3
+    for s in range(steps):
+        coord.contribute("w0", generation=1, step=s, rows=1, vec=v)
+        coord.contribute("w1", generation=1, step=s, rows=1, vec=v)
+        coord.wait_reduced("w0", generation=1, step=s, timeout=1.0)
+    assert len(coord._reduced) == _REDUCED_KEEP
+    kept = sorted(k[1] for k in coord._reduced)
+    assert kept == list(range(steps - _REDUCED_KEEP, steps))
+    # a recent step re-reads fine; an evicted one can never complete again
+    got = coord.wait_reduced("w1", generation=1, step=steps - 1, timeout=1.0)
+    np.testing.assert_array_equal(got, v)
+
+
+def test_chain_reduced_steps_advance_from_heartbeat_floor():
+    """On the peer-to-peer plane the coordinator sees no gradients;
+    ``reduced_steps`` is the min over members' heartbeat-reported steps —
+    monotone even when a reformed member reports an anchor-rolled-back
+    step."""
+    coord, clock = _form(2)
+    coord.heartbeat("w0", generation=1, step=3)
+    coord.heartbeat("w1", generation=1, step=2)
+    assert coord.reduced_steps == 2          # floor, not max
+    coord.heartbeat("w1", generation=1, step=5)
+    assert coord.reduced_steps == 3
+    coord.heartbeat("w0", generation=1, step=0)   # rollback replay: ignored
+    assert coord.reduced_steps == 3
+    # the final result payload also advances the floor (a worker may
+    # finish between heartbeats)
+    coord.result("w0", {"steps": 6})
+    coord.result("w1", {"steps": 6})
+    assert coord.reduced_steps == 6
+
+
+def test_coord_client_reuses_connection_and_reconnects_once():
+    """Control RPCs ride ONE persistent keep-alive connection per thread
+    (serving/client.py pattern); a dropped socket reconnects once
+    transparently instead of surfacing to the retry loop."""
+    from deeplearning4j_tpu.exec.elastic import CoordinatorServer
+    from deeplearning4j_tpu.exec.worker import CoordClient
+    coord = ElasticCoordinator(1)
+    srv = CoordinatorServer(coord)
+    srv.start()
+    try:
+        client = CoordClient(srv.url, "w0")
+        client.state()
+        conn1 = client._local.conn
+        sock1 = conn1.sock
+        assert sock1 is not None
+        client.state()
+        assert client._local.conn is conn1       # same connection reused
+        assert conn1.sock is sock1               # ... and the same socket
+        conn1.close()                            # server idle-closed it
+        client.state()                           # reconnect-once, no error
+        assert client._local.conn.sock is not None
+        assert client._local.conn.sock is not sock1
+        client.close()
+    finally:
+        srv.stop()
+
+
 def test_wait_reduced_fenced_when_membership_changes_mid_barrier():
     coord, _ = _form(2)
     coord.contribute("w0", generation=1, step=0, rows=2,
@@ -252,27 +320,40 @@ def _digests(res):
     return {w: r["params_digest"] for w, r in res["results"].items()}
 
 
-def test_cluster_n2_smoke_parity_with_single_process(tmp_path):
+def test_cluster_n2_chain_bitwise_vs_star_vs_single_process(tmp_path):
+    """The data-plane parity triangle (docs/ELASTIC_TRAINING.md "Data
+    plane"): the default chunk-pipelined chain, the PR 19 star fallback and
+    the in-process single-process replay of the same job must all land on
+    the SAME final params digest — the chain's rank-ordered accumulation
+    is bitwise, not approximately, the star's arithmetic."""
     from deeplearning4j_tpu.exec.cluster import ClusterManager
-    res2 = ClusterManager(tmp_path / "n2", workers=2, total_steps=6,
-                          global_batch=32, ckpt_every=3,
-                          aot=True).run(timeout=180)
+    from deeplearning4j_tpu.exec.worker import single_process_reference
+    ref = single_process_reference(model="mlp", seed=42, total_steps=6,
+                                   global_batch=32, world=2)
+
+    mgr = ClusterManager(tmp_path / "chain", workers=2, total_steps=6,
+                         global_batch=32, ckpt_every=3, aot=True)
+    res2 = mgr.run(timeout=180)
     d2 = _digests(res2)
     assert len(d2) == 2 and len(set(d2.values())) == 1, d2
-    assert res2["reduced_steps"] == 6
+    assert set(d2.values()) == {ref["params_digest"]}, (d2, ref)
+    assert res2["reduced_steps"] == 6    # inferred from heartbeat floor
     assert res2["spawns"] == 2 and res2["replacements"] == 0
     assert res2["generation"] == 1       # membership never changed
     assert res2["checkpoint"] is not None
+    # control plane only: no gradient ever passed through the coordinator
+    assert not mgr.coord._reduced and not mgr.coord._barriers
+    for r in res2["results"].values():
+        assert r["comms"]["data_plane"] == "chain"
+        assert r["comms"]["bytes_sent"] > 0 and r["comms"]["bytes_recv"] > 0
 
-    # same job, world of one: the loss trajectory must agree (tolerance,
-    # not bitwise — the rank-ordered sum associates floats differently)
-    res1 = ClusterManager(tmp_path / "n1", workers=1, total_steps=6,
-                          global_batch=32, ckpt_every=3,
-                          aot=False).run(timeout=180)
-    (l1,) = [r["final_loss"] for r in res1["results"].values()]
-    (l2,) = {r["final_loss"] for r in res2["results"].values()}
-    assert np.isfinite(l1) and np.isfinite(l2)
-    assert l2 == pytest.approx(l1, rel=1e-3), (l1, l2)
+    res_star = ClusterManager(tmp_path / "star", workers=2, total_steps=6,
+                              global_batch=32, ckpt_every=3, aot=True,
+                              data_plane="star").run(timeout=180)
+    ds = _digests(res_star)
+    assert set(ds.values()) == {ref["params_digest"]}, (ds, ref)
+    for r in res_star["results"].values():
+        assert r["comms"]["data_plane"] == "star"
 
 
 @pytest.mark.slow
@@ -334,17 +415,48 @@ def test_kill_before_first_checkpoint_recovers_bitwise(tmp_path):
 
 
 @pytest.mark.slow
+def test_threshold_codec_survives_kill_and_resets_residuals(tmp_path):
+    """Lossy codec under chaos: a SIGKILL mid-run reforms the chain and the
+    job still converges — and every member that lived through the reform
+    reports residual_resets >= 1 (stale error feedback fenced out with the
+    dead generation), while wire bytes stay well under dense."""
+    from deeplearning4j_tpu.exec.cluster import ClusterManager
+    mgr = ClusterManager(tmp_path / "thr", workers=3, total_steps=10,
+                         global_batch=30, ckpt_every=3, aot=True,
+                         model="charlstm", codec="threshold",
+                         bucket_mb=0.005, capacity_fraction=0.05,
+                         chaos={1: "die_at_step=5"})
+    res = mgr.run(timeout=240)
+    assert res["replacements"] == 1 and res["spawns"] == 4
+    assert res["reduced_steps"] == 10
+    digs = _digests(res)
+    assert len(set(digs.values())) == 1, digs    # members agree with each
+    for wid, r in res["results"].items():        # other (not with dense)
+        assert np.isfinite(r["final_loss"])
+        assert r["comms"]["codec"] == "threshold"
+        assert r["comms"]["compression_ratio"] > 2.0, (wid, r["comms"])
+    for wid in ("w0", "w2"):                     # reform survivors
+        assert res["results"][wid]["comms"]["residual_resets"] >= 1, wid
+
+
+@pytest.mark.slow
 def test_partition_evicts_and_cluster_continues_degraded(tmp_path):
     """Blackholed coordinator link: the worker process stays alive but its
     heartbeats vanish — lease expiry evicts it and, with no replacement,
     the grace window expires into an N-1 degraded commit that finishes
-    the job."""
+    the job. Every seat carries slow_ms chaos so the remaining steps
+    outlast the eviction window: on the peer-to-peer chain the gradient
+    plane does NOT die with the coordinator link, so a fast job would
+    otherwise finish through the healthy 3-chain before the lease ever
+    expired (the control/data-plane split working as designed, but not
+    the path this drill pins)."""
     from deeplearning4j_tpu.exec.cluster import ClusterManager
     mgr = ClusterManager(tmp_path / "part", workers=3, total_steps=10,
                          global_batch=30, ckpt_every=3, aot=False,
                          hb_interval=0.2, suspect_after=0.8,
                          evict_after=2.0, replacement_grace=2.0,
-                         replace=False, partition=[2])
+                         replace=False, partition=[2],
+                         chaos={i: "slow_ms=700" for i in range(3)})
     mgr.start()
     try:
         deadline = time.monotonic() + 120

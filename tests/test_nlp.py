@@ -211,6 +211,7 @@ def test_word2vec_cbow_hierarchical_softmax():
         w2v.similarity("stocks", "kitten") + 0.1
 
 
+@pytest.mark.slow
 def test_cbow_hs_batch_matches_autodiff():
     """The hand-written CBOW-HS scatter update equals -lr * d(loss)/d(params)
     of the Huffman-path NLL at the same point (single-occurrence indices, so

@@ -64,6 +64,7 @@ def test_textgenlstm_pretrained_reproduces_recorded_accuracy():
     assert acc > 0.2                      # far above chance (~1/vocab)
 
 
+@pytest.mark.slow
 def test_resnet50_cifar_pretrained_reproduces_recorded_accuracy():
     """Bundled ComputationGraph artifact — proves init_pretrained moves CG
     weights (conf + arrays + graph topology) end-to-end."""

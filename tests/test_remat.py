@@ -107,6 +107,7 @@ def _small_residual_cg(remat):
     return ComputationGraph(g.build()).init()
 
 
+@pytest.mark.slow
 def test_remat_cg_small_identical_training():
     rs = np.random.RandomState(1)
     x = rs.rand(4, 8, 8, 3).astype(np.float32)

@@ -49,6 +49,7 @@ def test_vgg19_constructs():
     assert len(conf.layers) == 24  # 16 conv + 5 pool + 3 dense/out
 
 
+@pytest.mark.slow
 def test_darknet19_small():
     _fwd_check(Darknet19(num_classes=10, input_shape=(64, 64, 3)), (64, 64, 3), 10)
 
@@ -61,6 +62,7 @@ def test_textgen_lstm():
     assert out.shape == (2, 6, 30)
 
 
+@pytest.mark.slow
 def test_resnet50():
     net = _fwd_check(ResNet50(num_classes=10, input_shape=(64, 64, 3)),
                      (64, 64, 3), 10)
@@ -158,6 +160,7 @@ def test_pretrained_checksum_verification(tmp_path, monkeypatch):
         model.init_pretrained()
 
 
+@pytest.mark.slow
 def test_zoo_bf16_inference_output():
     """compute_dtype='bfloat16' must work for INFERENCE too: eval-mode BN
     normalizes with f32 running stats against bf16 activations (was: mixed

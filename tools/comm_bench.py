@@ -1,0 +1,163 @@
+"""Microbench the gradient data plane (exec/comms.py) standalone.
+
+Spins N in-process ``ChainComms`` members over loopback TCP — no
+coordinator, no training, no jax — and times repeated allreduces of a
+synthetic gradient vector. Reports, per configuration:
+
+- **bytes/step** on the wire per member (headers + payload, both
+  directions) and the dense-equivalent compression ratio,
+- **bucket pipeline occupancy** — mean per-bucket reduce-hop wall over
+  the whole allreduce wall; near ``1/buckets`` means no overlap (each
+  bucket waited its full turn), values well above it mean buckets were
+  genuinely in flight concurrently,
+- **effective bandwidth per link** — payload bytes moved over the
+  allreduce wall, the number to compare against raw loopback throughput.
+
+    python tools/comm_bench.py --mb 8 --world 3 --bucket-mb 1
+    python tools/comm_bench.py --mb 8 --codec threshold --sparsity 0.98
+
+Sweeps: pass several ``--bucket-mb`` values to see the pipelining
+tradeoff (one giant bucket = no overlap; tiny buckets = per-frame
+overhead dominates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _form(world, codec, bucket_mb, codec_opts):
+    from deeplearning4j_tpu.exec.comms import ChainComms
+    members = [ChainComms(codec=codec, bucket_mb=bucket_mb,
+                          codec_opts=codec_opts) for _ in range(world)]
+    eps = {r: ("127.0.0.1", m.data_port) for r, m in enumerate(members)}
+    errs = []
+
+    def cfg(r):
+        try:
+            members[r].configure(1, r, world, eps)
+        except BaseException as e:      # noqa: BLE001
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=cfg, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise RuntimeError(f"chain formation failed: {errs}")
+    return members
+
+
+def _step(members, step, vecs):
+    out = [None] * len(members)
+    errs = []
+
+    def go(r):
+        try:
+            out[r] = members[r].allreduce(step, vecs[r], 1)
+        except BaseException as e:      # noqa: BLE001
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=go, args=(r,)) for r in range(len(members))]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise RuntimeError(f"allreduce failed: {errs}")
+    return time.perf_counter() - t0, out
+
+
+def bench_one(mb, world, codec, bucket_mb, steps, sparsity, seed=0):
+    n = 1 + int(mb * 1024 * 1024) // 4
+    rng = np.random.default_rng(seed)
+    vecs = []
+    for _ in range(world):
+        v = rng.normal(scale=0.05, size=n).astype(np.float32)
+        if sparsity > 0:
+            mask = rng.random(n) < sparsity
+            v[mask] = 0.0
+        vecs.append(v)
+    codec_opts = {"capacity_fraction": max(0.005, 1.0 - sparsity)} \
+        if codec == "threshold" else None
+    members = _form(world, codec, bucket_mb, codec_opts)
+    try:
+        _step(members, 0, vecs)                 # warm the path
+        walls = []
+        for s in range(1, steps + 1):
+            wall, _ = _step(members, s, vecs)
+            walls.append(wall)
+        wall = statistics.median(walls)
+        m0 = members[0]
+        stats = dict(m0.last)
+        # an interior member forwards on both sides — the busiest link
+        busiest = members[min(1, world - 1)]
+        payload = busiest.last["payload_sent"]
+        occupancy = (stats["buckets"] * _mean_bucket_s(members)
+                     / stats["wall_s"]) if stats["wall_s"] else 0.0
+        return {
+            "mb": mb, "world": world, "codec": codec,
+            "bucket_mb": bucket_mb, "buckets": stats["buckets"],
+            "wall_s_median": round(wall, 4),
+            "bytes_per_step": stats["bytes_sent"] + stats["bytes_recv"],
+            "compression_ratio": round(stats["compression_ratio"], 2),
+            "pipeline_occupancy": round(occupancy, 3),
+            "link_bandwidth_mb_s": round(
+                payload / max(wall, 1e-9) / (1024 * 1024), 1),
+        }
+    finally:
+        for m in members:
+            m.close()
+
+
+def _mean_bucket_s(members):
+    """Mean reduce-hop seconds per bucket, read back from the histogram
+    this process's members just fed."""
+    from deeplearning4j_tpu.monitor import get_registry
+    text = get_registry().render()
+    tot = cnt = None
+    for line in text.splitlines():
+        if line.startswith("dl4jtpu_cluster_bucket_seconds_sum"):
+            tot = float(line.rsplit(" ", 1)[1])
+        elif line.startswith("dl4jtpu_cluster_bucket_seconds_count"):
+            cnt = float(line.rsplit(" ", 1)[1])
+    return (tot / cnt) if tot and cnt else 0.0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="microbench the chain gradient data plane")
+    ap.add_argument("--mb", type=float, default=8.0,
+                    help="synthetic gradient size in MB of f32")
+    ap.add_argument("--world", type=int, default=3)
+    ap.add_argument("--codec", default="dense",
+                    choices=("dense", "threshold"))
+    ap.add_argument("--bucket-mb", type=float, nargs="*", default=[1.0],
+                    help="bucket sizes to sweep")
+    ap.add_argument("--steps", type=int, default=5,
+                    help="timed allreduces per configuration (median)")
+    ap.add_argument("--sparsity", type=float, default=0.0,
+                    help="fraction of zero elements in the synthetic "
+                         "gradient (exercises the sparse wire format)")
+    a = ap.parse_args(argv)
+
+    rows = [bench_one(a.mb, a.world, a.codec, bmb, a.steps, a.sparsity)
+            for bmb in a.bucket_mb]
+    print(json.dumps(rows, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

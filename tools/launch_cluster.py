@@ -65,6 +65,24 @@ def main(argv=None) -> int:
     ap.add_argument("--no-replace", action="store_true",
                     help="let evictions degrade the world instead of "
                          "spawning replacements")
+    ap.add_argument("--data-plane", default="chain",
+                    choices=("chain", "star"),
+                    help="gradient transport: peer-to-peer chunk-pipelined "
+                         "chain (default) or the coordinator-reduced star "
+                         "kept as the parity oracle")
+    ap.add_argument("--codec", default="dense",
+                    choices=("dense", "threshold"),
+                    help="wire codec on the chain: exact dense f32 "
+                         "(bitwise parity) or Strom-style threshold "
+                         "compression with error-feedback residuals")
+    ap.add_argument("--bucket-mb", type=float, default=4.0,
+                    help="pipelined bucket size in MB of f32 "
+                         "(docs/ELASTIC_TRAINING.md tuning table)")
+    ap.add_argument("--threshold", type=float, default=1e-3,
+                    help="initial threshold for --codec threshold")
+    ap.add_argument("--capacity-fraction", type=float, default=0.1,
+                    help="max fraction of elements a threshold message "
+                         "may carry")
     ap.add_argument("--timeout", type=float, default=600.0)
     a = ap.parse_args(argv)
 
@@ -76,7 +94,9 @@ def main(argv=None) -> int:
         total_steps=a.steps, global_batch=a.global_batch, model=a.model,
         seed=a.seed, ckpt_every=a.ckpt_every, aot=not a.no_aot,
         replace=not a.no_replace, chaos=_parse_chaos(a.chaos),
-        partition=a.partition)
+        partition=a.partition, data_plane=a.data_plane, codec=a.codec,
+        bucket_mb=a.bucket_mb, threshold=a.threshold,
+        capacity_fraction=a.capacity_fraction)
     print(f"coordinator up; workdir={workdir}", file=sys.stderr)
     mgr.start()
     try:
